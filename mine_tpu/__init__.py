@@ -1,0 +1,26 @@
+"""mine_tpu — a TPU-native (JAX/XLA/Pallas) framework for single-image novel view
+synthesis with continuous-depth Multiplane Images (MPI + NeRF-style volume rendering).
+
+Re-designed from scratch for TPU hardware with the capability surface of the
+reference PyTorch implementation (zubair-irshad/MINE):
+
+  - `ops/`       stateless, jittable geometry / warping / compositing kernels,
+                 vmapped over the plane axis S (reference: operations/)
+  - `models/`    Flax encoder-decoder predicting an MPI from one RGB image
+                 (reference: network/)
+  - `training/`  one jit-compiled SPMD train step (fwd + 4-scale loss + grad +
+                 update), orbax checkpointing, metric logging
+                 (reference: synthesis_task.py + train.py)
+  - `data/`      COLMAP / LLFF / synthetic input pipelines feeding sharded
+                 device batches (reference: input_pipelines/)
+  - `parallel/`  mesh construction, batch/plane sharding rules, plane-axis
+                 sharded compositing (the long-context analog of this model)
+  - `inference/` predict-once / render-many novel-view video generation
+                 (reference: visualizations/image_to_video.py)
+
+Design stance (vs the reference): pure functions over pytrees, explicit PRNG
+keys, static shapes under jit, NHWC layouts, closed-form 3x3 inverses instead
+of library LAPACK calls, and GSPMD sharding instead of NCCL process groups.
+"""
+
+__version__ = "0.1.0"

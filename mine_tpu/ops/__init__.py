@@ -1,0 +1,35 @@
+"""Stateless, jittable rendering / geometry ops (reference: operations/).
+
+Everything here is a pure function of arrays: no modules, no cached buffers,
+no device state. Plane-axis (S) batching is done with reshapes + vmap so XLA
+sees one large batched op per step.
+"""
+
+from mine_tpu.ops.geometry import (
+    inverse_3x3,
+    inverse_se3,
+    pixel_center_grid,
+    homogeneous_pixel_grid,
+    scale_intrinsics,
+    transform_se3,
+    get_src_xyz_from_plane_disparity,
+    get_tgt_xyz_from_plane_disparity,
+)
+from mine_tpu.ops.grid_sample import grid_sample_pixel
+from mine_tpu.ops.homography import (
+    build_plane_homography,
+    homography_sample,
+)
+from mine_tpu.ops.mpi_render import (
+    alpha_composition,
+    plane_volume_rendering,
+    weighted_sum_mpi,
+    render,
+    render_tgt_rgb_depth,
+)
+from mine_tpu.ops.sampling import (
+    uniform_disparity_from_linspace_bins,
+    uniform_disparity_from_bins,
+    sample_pdf,
+    gather_pixel_by_pxpy,
+)

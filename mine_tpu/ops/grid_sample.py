@@ -1,0 +1,76 @@
+"""Bilinear image sampling with border padding — the TPU replacement for
+`torch.nn.functional.grid_sample(..., padding_mode='border', align_corners=False)`
+(the per-plane warp workhorse, reference homography_sampler.py:147-148).
+
+Parity notes. The reference normalizes pixel coords p to the grid_sample
+convention as g = (p + 0.5) / (0.5 * size) - 1 (homography_sampler.py:145-146),
+and torch then unnormalizes with p' = ((g + 1) * size - 1) / 2 == p. So the
+composition is the identity: grid_sample effectively samples at raw pixel
+coordinates. We therefore skip the normalize/denormalize round-trip entirely
+and sample at pixel coordinates directly — fewer flops, bit-identical intent.
+
+Border padding in torch clamps the *coordinate* into [0, size-1] before the
+bilinear split, which is what `_clamp_coords` does here.
+
+Implementation: 4-corner gather over a flattened HW axis. XLA lowers this to
+a dynamic-gather; a Pallas kernel (mine_tpu/ops/pallas/) can replace it if the
+gather dominates profiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _gather_hw(img: Array, iy: Array, ix: Array) -> Array:
+    """img: (H, W, C); iy/ix: (...,) int32 -> (..., C)."""
+    h, w, _ = img.shape
+    flat = img.reshape(h * w, -1)
+    idx = iy * w + ix
+    return jnp.take(flat, idx, axis=0)
+
+
+def _sample_one(img: Array, coords: Array) -> Array:
+    """Bilinear-sample one image at pixel coords.
+
+    img: (H, W, C). coords: (..., 2) as (x, y) in pixel units.
+    Returns (..., C).
+    """
+    h, w, _ = img.shape
+    x = jnp.clip(coords[..., 0], 0.0, w - 1.0)
+    y = jnp.clip(coords[..., 1], 0.0, h - 1.0)
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    ix0 = x0.astype(jnp.int32)
+    iy0 = y0.astype(jnp.int32)
+    ix1 = jnp.minimum(ix0 + 1, w - 1)
+    iy1 = jnp.minimum(iy0 + 1, h - 1)
+
+    v00 = _gather_hw(img, iy0, ix0)
+    v01 = _gather_hw(img, iy0, ix1)
+    v10 = _gather_hw(img, iy1, ix0)
+    v11 = _gather_hw(img, iy1, ix1)
+
+    wx = wx[..., None]
+    wy = wy[..., None]
+    top = v00 * (1.0 - wx) + v01 * wx
+    bot = v10 * (1.0 - wx) + v11 * wx
+    return top * (1.0 - wy) + bot * wy
+
+
+def grid_sample_pixel(src: Array, coords: Array) -> Array:
+    """Batched bilinear sampling at pixel coordinates with border padding.
+
+    Args:
+      src: (B, H, W, C) source images.
+      coords: (B, Ho, Wo, 2) sample locations as (x, y) in src pixel units.
+    Returns:
+      (B, Ho, Wo, C) sampled values.
+    """
+    return jax.vmap(_sample_one)(src, coords)

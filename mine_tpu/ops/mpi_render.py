@@ -1,0 +1,158 @@
+"""MPI compositing: NeRF-style plane volume rendering and alpha composition.
+
+Reference: operations/mpi_rendering.py:7-82 (render / alpha_composition /
+plane_volume_rendering / weighted_sum_mpi) and :181-241 (render_tgt_rgb_depth).
+
+Layout is channel-last (B, S, H, W, C); the plane axis S is axis 1 and all
+scans/cumprods run over it. On a plane-sharded mesh the same math is provided
+by mine_tpu/parallel/plane_sharding.py with an explicit cross-device prefix.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from mine_tpu.ops.homography import homography_sample
+
+_BG_DIST = 1.0e3  # pseudo-distance behind the farthest plane (mpi_rendering.py:50)
+
+
+def _shifted_exclusive(x: Array, fill: float = 1.0) -> Array:
+    """[a, b, c] -> [fill, a, b] along the plane axis (axis=1)."""
+    ones = jnp.full_like(x[:, :1], fill)
+    return jnp.concatenate([ones, x[:, :-1]], axis=1)
+
+
+def alpha_composition(alpha: Array, value: Array) -> tuple[Array, Array]:
+    """Over-compositing of K planes, nearest first (mpi_rendering.py:23-39).
+
+    alpha: (B, K, H, W, 1); value: (B, K, H, W, C).
+    Returns composed (B, H, W, C) and per-plane weights (B, K, H, W, 1).
+    """
+    preserve = _shifted_exclusive(jnp.cumprod(1.0 - alpha, axis=1))
+    weights = alpha * preserve
+    return jnp.sum(value * weights, axis=1), weights
+
+
+def weighted_sum_mpi(
+    rgb: Array, xyz: Array, weights: Array, is_bg_depth_inf: bool = False
+) -> tuple[Array, Array]:
+    """Expectation of rgb and depth under compositing weights
+    (mpi_rendering.py:70-82).
+
+    rgb/xyz: (B, S, H, W, 3); weights: (B, S, H, W, 1).
+    Returns rgb_out (B, H, W, 3), depth_out (B, H, W, 1).
+    """
+    weights_sum = jnp.sum(weights, axis=1)  # (B, H, W, 1)
+    rgb_out = jnp.sum(weights * rgb, axis=1)
+    z = xyz[..., 2:3]
+    if is_bg_depth_inf:
+        depth_out = jnp.sum(weights * z, axis=1) + (1.0 - weights_sum) * 1000.0
+    else:
+        depth_out = jnp.sum(weights * z, axis=1) / (weights_sum + 1.0e-5)
+    return rgb_out, depth_out
+
+
+def plane_volume_rendering(
+    rgb: Array, sigma: Array, xyz: Array, is_bg_depth_inf: bool = False
+) -> tuple[Array, Array, Array, Array]:
+    """NeRF-style volume rendering across depth planes (mpi_rendering.py:42-67).
+
+    Per-pixel inter-plane distances turn sigma into transparency
+    T = exp(-sigma * dist); transmittance is a shifted cumprod over planes.
+
+    rgb: (B, S, H, W, 3); sigma: (B, S, H, W, 1); xyz: (B, S, H, W, 3).
+    Returns (rgb_out, depth_out, transparency_acc, weights).
+    """
+    diff = xyz[:, 1:] - xyz[:, :-1]  # (B, S-1, H, W, 3)
+    dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)  # (B, S-1, H, W, 1)
+    dist = jnp.concatenate(
+        [dist, jnp.full_like(dist[:, :1], _BG_DIST)], axis=1
+    )  # (B, S, H, W, 1)
+
+    transparency = jnp.exp(-sigma * dist)
+    alpha = 1.0 - transparency
+    # eps keeps the accumulated transmittance away from exactly zero
+    # (mpi_rendering.py:57-59)
+    transparency_acc = _shifted_exclusive(jnp.cumprod(transparency + 1.0e-6, axis=1))
+    weights = transparency_acc * alpha
+
+    rgb_out, depth_out = weighted_sum_mpi(rgb, xyz, weights, is_bg_depth_inf)
+    return rgb_out, depth_out, transparency_acc, weights
+
+
+def render(
+    rgb: Array,
+    sigma: Array,
+    xyz: Array,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """Dispatch sigma-vs-alpha compositing (mpi_rendering.py:7-20).
+
+    Returns (imgs_syn, depth_syn, blend_weights, weights). With use_alpha the
+    blend weights are zeros (no src-RGB blending path), as in the reference.
+    """
+    if not use_alpha:
+        return plane_volume_rendering(rgb, sigma, xyz, is_bg_depth_inf)
+    imgs_syn, weights = alpha_composition(sigma, rgb)
+    depth_syn, _ = alpha_composition(sigma, xyz[..., 2:3])
+    return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
+
+
+def render_tgt_rgb_depth(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    xyz_tgt: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array]:
+    """Warp the source MPI into the target camera and composite
+    (mpi_rendering.py:181-241).
+
+    Args:
+      mpi_rgb_src: (B, S, H, W, 3); mpi_sigma_src: (B, S, H, W, 1).
+      mpi_disparity_src: (B, S).
+      xyz_tgt: (B, S, H, W, 3) plane xyz already in the target frame — warped
+        alongside rgb/sigma because compositing needs target-frame distances.
+      g_tgt_src: (B, 4, 4); k_src_inv/k_tgt: (B, 3, 3).
+    Returns:
+      tgt_rgb (B, H, W, 3), tgt_depth (B, H, W, 1),
+      tgt_mask (B, H, W, 1) — number of planes whose warp lands in-FoV.
+    """
+    b, s, h, w, _ = mpi_rgb_src.shape
+    depth = 1.0 / mpi_disparity_src  # (B, S)
+
+    # 7 channels warped at once: rgb + sigma + target-frame xyz
+    payload = jnp.concatenate([mpi_rgb_src, mpi_sigma_src, xyz_tgt], axis=-1)
+    payload = payload.reshape(b * s, h, w, 7)
+
+    tile = lambda m: jnp.repeat(m, s, axis=0)  # (B, ...) -> (B*S, ...)
+    warped, valid = homography_sample(
+        payload,
+        depth.reshape(b * s),
+        tile(g_tgt_src),
+        tile(k_src_inv),
+        tile(k_tgt),
+    )
+    warped = warped.reshape(b, s, h, w, 7)
+    valid = valid.reshape(b, s, h, w)
+
+    tgt_rgb = warped[..., 0:3]
+    tgt_sigma = warped[..., 3:4]
+    tgt_xyz = warped[..., 4:7]
+
+    # planes behind the target camera contribute nothing
+    # (mpi_rendering.py:232-235)
+    tgt_sigma = jnp.where(tgt_xyz[..., 2:3] >= 0.0, tgt_sigma, 0.0)
+
+    tgt_rgb_syn, tgt_depth_syn, _, _ = render(
+        tgt_rgb, tgt_sigma, tgt_xyz, use_alpha=use_alpha, is_bg_depth_inf=is_bg_depth_inf
+    )
+    tgt_mask = jnp.sum(valid.astype(mpi_rgb_src.dtype), axis=1)[..., None]
+    return tgt_rgb_syn, tgt_depth_syn, tgt_mask

@@ -1,0 +1,100 @@
+"""Disparity sampling and sparse-point gathering.
+
+Reference: operations/rendering_utils.py:27-140. All randomness takes an
+explicit `jax.random` key (the reference draws from the global CUDA RNG,
+rendering_utils.py:65/:86/:115); keys are folded per-step by the train loop so
+data-parallel replicas see the shards of one logical stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def uniform_disparity_from_linspace_bins(
+    key: Array, batch_size: int, num_bins: int, start: float, end: float
+) -> Array:
+    """Stratified disparity samples: one uniform draw inside each of S linspace
+    bins spanning [start, end], start > end (descending disparity = near-to-far
+    planes). Reference: rendering_utils.py:70-88.
+    Returns (B, S).
+    """
+    assert start > end, "disparity must descend (near plane first)"
+    edges = jnp.linspace(start, end, num_bins + 1)
+    interval = edges[1] - edges[0]  # negative
+    u = jax.random.uniform(key, (batch_size, num_bins))
+    return edges[None, :-1] + interval * u
+
+
+def uniform_disparity_from_bins(key: Array, batch_size: int, disparity_edges: Array) -> Array:
+    """Stratified samples from explicit (S+1,) bin edges, descending.
+    Reference: rendering_utils.py:47-67. Returns (B, S).
+    """
+    edges = jnp.asarray(disparity_edges, dtype=jnp.float32)
+    interval = edges[1:] - edges[:-1]  # (S,)
+    s = edges.shape[0] - 1
+    u = jax.random.uniform(key, (batch_size, s))
+    return edges[None, :-1] + interval[None, :] * u
+
+
+def fixed_disparity_linspace(batch_size: int, num_bins: int, start: float, end: float) -> Array:
+    """Deterministic plane disparities (eval / inference path,
+    synthesis_task.py:41-45). Returns (B, S)."""
+    d = jnp.linspace(start, end, num_bins)
+    return jnp.broadcast_to(d[None, :], (batch_size, num_bins))
+
+
+def gather_pixel_by_pxpy(img: Array, pxpy: Array) -> Array:
+    """Nearest-pixel lookup of image values at continuous (x, y) positions.
+
+    img: (B, H, W, C); pxpy: (B, N, 2) float pixel coords.
+    Returns (B, N, C). Reference: rendering_utils.py:27-44 — indices are
+    round()ed, clamped, and carry no gradient; the gather itself is
+    differentiable w.r.t. img.
+    """
+    b, h, w, c = img.shape
+    idx = jax.lax.stop_gradient(jnp.round(pxpy)).astype(jnp.int32)
+    ix = jnp.clip(idx[..., 0], 0, w - 1)
+    iy = jnp.clip(idx[..., 1], 0, h - 1)
+    flat = img.reshape(b, h * w, c)
+    return jnp.take_along_axis(flat, (iy * w + ix)[..., None], axis=1)
+
+
+def sample_pdf(key: Array, values: Array, weights: Array, n_samples: int) -> Array:
+    """Inverse-CDF sampling of the piecewise distribution weights = p(values).
+
+    values/weights: (B, N, S) (the reference carries an extra singleton axis,
+    rendering_utils.py:91-140). Returns (B, N, n_samples).
+    Used by coarse-to-fine plane placement (mpi_rendering.py:244-268).
+    """
+    b, n, s = weights.shape
+
+    # midpoints as interior bin edges, endpoint values as outer edges
+    mid = 0.5 * (values[..., 1:] + values[..., :-1])
+    edges = jnp.concatenate([values[..., :1], mid, values[..., -1:]], axis=-1)  # (B,N,S+1)
+
+    pdf = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1.0e-5)
+    cdf = jnp.cumsum(pdf, axis=-1)
+    cdf = jnp.concatenate([jnp.zeros_like(cdf[..., :1]), cdf], axis=-1)  # (B,N,S+1)
+
+    u = jax.random.uniform(key, (b, n, n_samples), dtype=weights.dtype)
+
+    flat_cdf = cdf.reshape(b * n, s + 1)
+    flat_u = u.reshape(b * n, n_samples)
+    idx = jax.vmap(lambda c, q: jnp.searchsorted(c, q, side="right"))(flat_cdf, flat_u)
+    idx = idx.reshape(b, n, n_samples)
+    lo = jnp.clip(idx - 1, 0, s)
+    hi = jnp.clip(idx, 0, s)
+
+    take = lambda arr, i: jnp.take_along_axis(arr, i, axis=-1)
+    cdf_lo, cdf_hi = take(cdf, lo), take(cdf, hi)
+    bin_lo, bin_hi = take(edges, lo), take(edges, hi)
+
+    cdf_interval = cdf_hi - cdf_lo
+    t = (u - cdf_lo) / jnp.clip(cdf_interval, min=1.0e-5)
+    # degenerate (clamped) intervals sample the bin midpoint
+    # (rendering_utils.py:133-137)
+    t = jnp.where(cdf_interval <= 1.0e-4, 0.5, t)
+    return bin_lo + t * (bin_hi - bin_lo)

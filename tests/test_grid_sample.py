@@ -1,0 +1,57 @@
+"""Parity tests of the bilinear sampler against
+torch.nn.functional.grid_sample(padding_mode='border', align_corners=False),
+the exact native op the reference leans on (homography_sampler.py:147-148).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from mine_tpu.ops import grid_sample_pixel
+
+torch = pytest.importorskip("torch")
+
+
+def torch_grid_sample_at_pixels(src_nhwc, coords_xy):
+    """Run torch grid_sample with the reference's normalization
+    (homography_sampler.py:145-146)."""
+    b, h, w, c = src_nhwc.shape
+    src = torch.from_numpy(np.moveaxis(src_nhwc, -1, 1).copy())
+    grid = np.empty(coords_xy.shape, dtype=np.float32)
+    grid[..., 0] = (coords_xy[..., 0] + 0.5) / (w * 0.5) - 1
+    grid[..., 1] = (coords_xy[..., 1] + 0.5) / (h * 0.5) - 1
+    out = torch.nn.functional.grid_sample(
+        src, torch.from_numpy(grid), padding_mode="border", align_corners=False
+    )
+    return np.moveaxis(out.numpy(), 1, -1)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_matches_torch_random_coords(seed):
+    rng = np.random.default_rng(seed)
+    b, h, w, c = 2, 9, 13, 3
+    src = rng.standard_normal((b, h, w, c)).astype(np.float32)
+    # coords spanning in-bounds and far out-of-bounds
+    coords = rng.uniform(-5.0, 20.0, size=(b, 6, 7, 2)).astype(np.float32)
+
+    got = np.asarray(grid_sample_pixel(jnp.asarray(src), jnp.asarray(coords)))
+    want = torch_grid_sample_at_pixels(src, coords)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_identity_sampling():
+    rng = np.random.default_rng(2)
+    b, h, w, c = 1, 5, 6, 2
+    src = rng.standard_normal((b, h, w, c)).astype(np.float32)
+    xv, yv = np.meshgrid(np.arange(w, dtype=np.float32), np.arange(h, dtype=np.float32))
+    coords = np.stack([xv, yv], axis=-1)[None]
+    got = np.asarray(grid_sample_pixel(jnp.asarray(src), jnp.asarray(coords)))
+    np.testing.assert_allclose(got, src, atol=1e-6)
+
+
+def test_border_clamp():
+    src = np.arange(12, dtype=np.float32).reshape(1, 3, 4, 1)
+    coords = np.array([[[[-10.0, -10.0], [100.0, 100.0]]]], dtype=np.float32)
+    got = np.asarray(grid_sample_pixel(jnp.asarray(src), jnp.asarray(coords)))
+    assert got[0, 0, 0, 0] == 0.0  # top-left corner
+    assert got[0, 0, 1, 0] == 11.0  # bottom-right corner

@@ -1,0 +1,64 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mine_tpu.ops import (
+    gather_pixel_by_pxpy,
+    sample_pdf,
+    uniform_disparity_from_bins,
+    uniform_disparity_from_linspace_bins,
+)
+
+
+def test_stratified_linspace_within_bins():
+    key = jax.random.PRNGKey(0)
+    b, s = 4, 32
+    start, end = 1.0, 0.001
+    d = np.asarray(uniform_disparity_from_linspace_bins(key, b, s, start, end))
+    assert d.shape == (b, s)
+    edges = np.linspace(start, end, s + 1)
+    # each sample inside its own (descending) bin
+    assert np.all(d <= edges[:-1][None] + 1e-6)
+    assert np.all(d >= edges[1:][None] - 1e-6)
+    # descending order overall
+    assert np.all(np.diff(d, axis=1) < 0)
+
+
+def test_stratified_explicit_bins():
+    key = jax.random.PRNGKey(1)
+    edges = np.array([1.0, 0.5, 0.2, 0.05], dtype=np.float32)
+    d = np.asarray(uniform_disparity_from_bins(key, 3, edges))
+    assert d.shape == (3, 3)
+    assert np.all(d <= edges[:-1][None] + 1e-6)
+    assert np.all(d >= edges[1:][None] - 1e-6)
+
+
+def test_gather_pixel_by_pxpy_rounds_and_clamps():
+    img = jnp.arange(24, dtype=jnp.float32).reshape(1, 4, 6, 1)
+    pxpy = jnp.array([[[0.4, 0.4], [4.6, 2.6], [100.0, 100.0], [-3.0, -3.0]]])
+    out = np.asarray(gather_pixel_by_pxpy(img, pxpy))[0, :, 0]
+    # round(0.4)=0 -> pixel (0,0)=0; round(4.6)=5, round(2.6)=3 -> 3*6+5=23
+    np.testing.assert_allclose(out, [0.0, 23.0, 23.0, 0.0])
+
+
+def test_sample_pdf_concentrates_mass():
+    """All weight on one bin -> every sample falls inside that bin's edges."""
+    b, n, s = 1, 1, 8
+    values = jnp.linspace(1.0, 0.1, s).reshape(1, 1, s)
+    weights = jnp.zeros((b, n, s)).at[:, :, 3].set(1.0)
+    samples = np.asarray(sample_pdf(jax.random.PRNGKey(2), values, weights, 64))
+    v = np.asarray(values)[0, 0]
+    lo_edge = 0.5 * (v[3] + v[4])  # descending values
+    hi_edge = 0.5 * (v[2] + v[3])
+    assert np.all(samples >= lo_edge - 1e-5)
+    assert np.all(samples <= hi_edge + 1e-5)
+
+
+def test_sample_pdf_uniform_statistics():
+    """Uniform weights -> samples roughly uniform over the value range."""
+    s = 16
+    values = jnp.linspace(0.0, 1.0, s).reshape(1, 1, s)
+    weights = jnp.ones((1, 1, s))
+    samples = np.asarray(sample_pdf(jax.random.PRNGKey(3), values, weights, 4096))
+    assert abs(samples.mean() - 0.5) < 0.03
+    assert samples.min() >= 0.0 and samples.max() <= 1.0
