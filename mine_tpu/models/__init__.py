@@ -1,0 +1,12 @@
+"""Flax model zoo (reference: network/).
+
+ResNetEncoder + MPIDecoder mirror the reference's
+ResnetEncoder/DepthDecoder contracts (5-feature pyramid; per-plane
+disparity-conditioned 4-scale RGB+sigma MPI output) in NHWC with
+cross-replica-syncable BatchNorm.
+"""
+
+from mine_tpu.models.embedder import embed_dim, positional_encode
+from mine_tpu.models.encoder import ResNetEncoder, encoder_channels
+from mine_tpu.models.decoder import MPIDecoder, NUM_CH_DEC, nearest_up2
+from mine_tpu.models.mpi import MPINetwork, predict_mpi_coarse_to_fine

@@ -1,0 +1,38 @@
+"""NeRF positional encoding for scalar plane disparities.
+
+Reference: utils.py:147-196 (Embedder / get_embedder). The reference builds a
+list of closures at init; here the whole embedding is one vectorized op —
+frequencies are a compile-time constant folded into the jit.
+
+Output layout matches the reference's embed-fn ordering exactly:
+[x, sin(f0 x), cos(f0 x), sin(f1 x), cos(f1 x), ...] with
+f_k = 2**k for log-sampled frequencies (multires 10 -> out_dim 21 for 1-D in).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def embed_dim(multires: int, input_dims: int = 1, include_input: bool = True) -> int:
+    """Output dimension of `positional_encode` (utils.py:156-172)."""
+    d = input_dims if include_input else 0
+    return d + 2 * multires * input_dims
+
+
+def positional_encode(x: Array, multires: int, include_input: bool = True) -> Array:
+    """Encode (..., D) inputs to (..., embed_dim) features.
+
+    Log-sampled frequency bands 2**linspace(0, multires-1, multires)
+    (utils.py:164-165), interleaved sin/cos per frequency (utils.py:169-172).
+    """
+    freqs = 2.0 ** jnp.arange(multires, dtype=x.dtype)  # (F,)
+    # (..., F, D): angle per frequency per input dim
+    ang = x[..., None, :] * freqs[:, None]
+    # interleave sin/cos along a new axis then flatten to (..., 2*F*D)
+    sc = jnp.stack([jnp.sin(ang), jnp.cos(ang)], axis=-2)  # (..., F, 2, D)
+    sc = sc.reshape(*x.shape[:-1], -1)
+    if include_input:
+        return jnp.concatenate([x, sc], axis=-1)
+    return sc

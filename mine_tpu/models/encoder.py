@@ -1,0 +1,150 @@
+"""ResNet pyramid encoder (the monodepth2-style backbone).
+
+Reference contract: network/monodepth2/resnet_encoder.py:64-113 —
+ResNet-18/34/50/101/152, ImageNet mean/std normalization applied inline on the
+raw [0,1] input, returns the 5-feature pyramid
+(conv1_out, block1..4_out) at strides (2, 4, 8, 16, 32) with channel widths
+[64, 64, 128, 256, 512] (x4 on the last four for Bottleneck nets,
+resnet_encoder.py:86-87). Multi-image input variant = `num_input_images` frames
+stacked on channels (resnet_encoder.py:19-61).
+
+TPU-first design: NHWC layout, Flax BatchNorm with `axis_name` for
+cross-replica stat sync (the reference reaches the same semantics by wrapping
+in torch SyncBatchNorm at the task layer, synthesis_task.py:107-115 — here it
+is a property of the module, not a wrapper). Compute dtype is configurable
+(bf16 for MXU); BN statistics always accumulate in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import Array
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+_STAGE_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+                 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+_BOTTLENECK = {50, 101, 152}
+
+
+def encoder_channels(num_layers: int) -> tuple[int, ...]:
+    """Pyramid channel widths (resnet_encoder.py:70, :86-87)."""
+    base = (64, 64, 128, 256, 512)
+    if num_layers in _BOTTLENECK:
+        return (base[0],) + tuple(c * 4 for c in base[1:])
+    return base
+
+
+class _BatchNorm(nn.Module):
+    """BN matching torch defaults (momentum 0.1 -> flax 0.9, eps 1e-5) with
+    optional cross-replica stat reduction."""
+
+    axis_name: str | None = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1.0e-5,
+            dtype=self.dtype,
+            axis_name=self.axis_name if train else None,
+        )(x)
+
+
+class BasicBlock(nn.Module):
+    features: int
+    strides: int = 1
+    axis_name: str | None = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        bn = lambda: _BatchNorm(self.axis_name, self.dtype)
+        residual = x
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    padding=1, use_bias=False, dtype=self.dtype)(x)
+        y = bn()(y, train)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype)(y)
+        y = bn()(y, train)
+        if self.strides != 1 or x.shape[-1] != self.features:
+            residual = nn.Conv(self.features, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               dtype=self.dtype)(x)
+            residual = bn()(residual, train)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    features: int  # output width (4x the squeeze width)
+    strides: int = 1
+    axis_name: str | None = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        bn = lambda: _BatchNorm(self.axis_name, self.dtype)
+        squeeze = self.features // 4
+        residual = x
+        y = nn.Conv(squeeze, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(bn()(y, train))
+        y = nn.Conv(squeeze, (3, 3), (self.strides, self.strides), padding=1,
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.relu(bn()(y, train))
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = bn()(y, train)
+        if self.strides != 1 or x.shape[-1] != self.features:
+            residual = nn.Conv(self.features, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               dtype=self.dtype)(x)
+            residual = bn()(residual, train)
+        return nn.relu(y + residual)
+
+
+class ResNetEncoder(nn.Module):
+    """5-feature pyramid backbone (resnet_encoder.py:94-113).
+
+    __call__ takes NHWC [0,1] images, returns a list of 5 NHWC features at
+    strides 2/4/8/16/32.
+    """
+
+    num_layers: int = 50
+    num_input_images: int = 1
+    axis_name: str | None = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool = True) -> list[Array]:
+        if self.num_layers not in _STAGE_BLOCKS:
+            raise ValueError(f"{self.num_layers} is not a valid resnet depth")
+        blocks = _STAGE_BLOCKS[self.num_layers]
+        block_cls = Bottleneck if self.num_layers in _BOTTLENECK else BasicBlock
+        widths = encoder_channels(self.num_layers)[1:]
+
+        # inline ImageNet normalization (resnet_encoder.py:96); the mean/std
+        # tile across stacked input frames for multi-image input
+        mean = jnp.asarray(IMAGENET_MEAN * self.num_input_images, x.dtype)
+        std = jnp.asarray(IMAGENET_STD * self.num_input_images, x.dtype)
+        x = (x - mean) / std
+        x = x.astype(self.dtype)
+
+        x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = _BatchNorm(self.axis_name, self.dtype)(x, train)
+        conv1_out = nn.relu(x)
+
+        x = nn.max_pool(conv1_out, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        feats = [conv1_out]
+        for stage, (n_blocks, width) in enumerate(zip(blocks, widths)):
+            for b in range(n_blocks):
+                strides = 2 if (stage > 0 and b == 0) else 1
+                x = block_cls(width, strides, self.axis_name, self.dtype)(x, train)
+            feats.append(x)
+        return feats

@@ -30,6 +30,7 @@ from mine_tpu.ops.mpi_render import (
 from mine_tpu.ops.sampling import (
     uniform_disparity_from_linspace_bins,
     uniform_disparity_from_bins,
+    fixed_disparity_linspace,
     sample_pdf,
     gather_pixel_by_pxpy,
 )

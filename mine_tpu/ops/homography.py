@@ -17,12 +17,16 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from mine_tpu.ops.geometry import _PRECISION, homogeneous_pixel_grid, inverse_3x3
 from mine_tpu.ops.grid_sample import grid_sample_pixel
 
-PLANE_NORMAL = jnp.array([0.0, 0.0, 1.0])  # fronto-parallel planes
+# np (not jnp): a module-level jnp constant would initialize the JAX backend at
+# import time, committing the platform before callers can set JAX_PLATFORMS /
+# XLA_FLAGS. Broadcasts identically inside the einsum.
+PLANE_NORMAL = np.array([0.0, 0.0, 1.0])  # fronto-parallel planes
 
 
 def build_plane_homography(
@@ -78,7 +82,11 @@ def homography_sample(
     # blocks gradient through the inverse (homography_sampler.py:116-117).
     h_src_tgt = jax.lax.stop_gradient(inverse_3x3(h_tgt_src))
 
-    grid = homogeneous_pixel_grid(h_tgt, w_tgt, src.dtype)  # (Ht, Wt, 3)
+    # Coordinate math stays fp32 regardless of payload dtype: bf16 cannot
+    # represent integer pixel coords above 256 (multi-pixel warp error at
+    # standard resolutions). Only the gathered payload keeps src.dtype.
+    h_src_tgt = h_src_tgt.astype(jnp.float32)
+    grid = homogeneous_pixel_grid(h_tgt, w_tgt, jnp.float32)  # (Ht, Wt, 3)
     src_homo = jnp.einsum("bij,hwj->bhwi", h_src_tgt, grid, precision=_PRECISION)  # (B, Ht, Wt, 3)
     # Guard the perspective divide: at degenerate poses (plane edge-on to the
     # target camera) z crosses 0 and NaN/inf coordinates would leak into the
@@ -95,5 +103,5 @@ def homography_sample(
         & (src_xy[..., 1] > -1.0)
         & (src_xy[..., 1] < h_src)
     )
-    warped = grid_sample_pixel(src, src_xy)
+    warped = grid_sample_pixel(src, src_xy).astype(src.dtype)
     return warped, valid

@@ -1,0 +1,109 @@
+"""Model contract tests: feature-pyramid shapes, decoder MPI shapes,
+sigma/rgb activation ranges, BN mutation, embedder parity with the
+reference formula (utils.py:147-196)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mine_tpu.models import (
+    MPIDecoder,
+    MPINetwork,
+    ResNetEncoder,
+    embed_dim,
+    encoder_channels,
+    positional_encode,
+    predict_mpi_coarse_to_fine,
+)
+
+
+def test_embedder_dim_and_values():
+    assert embed_dim(10) == 21  # reference: multires 10 -> out_dim 21
+    x = jnp.array([[0.3], [1.7]])
+    out = positional_encode(x, multires=4)
+    assert out.shape == (2, 1 + 2 * 4)
+    # layout: [x, sin(1x), cos(1x), sin(2x), cos(2x), sin(4x), cos(4x), ...]
+    np.testing.assert_allclose(out[:, 0], x[:, 0], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], np.sin(0.3), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2], np.cos(0.3), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 3], np.sin(0.6), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 6], np.cos(4 * 0.3), rtol=1e-5)
+
+
+def test_encoder_channels():
+    assert encoder_channels(18) == (64, 64, 128, 256, 512)
+    assert encoder_channels(50) == (64, 256, 512, 1024, 2048)
+
+
+@pytest.mark.parametrize("num_layers", [18, 50])
+def test_encoder_pyramid_shapes(num_layers):
+    enc = ResNetEncoder(num_layers=num_layers)
+    x = jnp.zeros((1, 64, 128, 3))
+    vars_ = enc.init(jax.random.PRNGKey(0), x, train=False)
+    feats = enc.apply(vars_, x, train=False)
+    chans = encoder_channels(num_layers)
+    assert len(feats) == 5
+    for i, (f, c) in enumerate(zip(feats, chans)):
+        stride = 2 ** (i + 1)
+        assert f.shape == (1, 64 // stride, 128 // stride, c), (i, f.shape)
+
+
+def test_decoder_mpi_shapes_and_ranges():
+    b, s, h, w = 1, 3, 128, 128
+    chans = encoder_channels(18)
+    feats = [
+        jnp.ones((b, h // 2 ** (i + 1), w // 2 ** (i + 1), c)) * 0.1
+        for i, c in enumerate(chans)
+    ]
+    disp = jnp.linspace(1.0, 0.01, s)[None].repeat(b, 0)
+    dec = MPIDecoder(multires=10)
+    vars_ = dec.init(jax.random.PRNGKey(0), feats, disp, train=False)
+    out = dec.apply(vars_, feats, disp, train=False)
+    assert set(out.keys()) == {0, 1, 2, 3}
+    for sc in range(4):
+        assert out[sc].shape == (b, s, h // 2**sc, w // 2**sc, 4)
+    rgb, sigma = out[0][..., :3], out[0][..., 3:]
+    assert (rgb >= 0).all() and (rgb <= 1).all()
+    assert (sigma >= 1e-4).all()  # abs + 1e-4 activation
+
+
+def test_decoder_bn_mutates_in_train_mode():
+    b, s = 1, 2
+    chans = encoder_channels(18)
+    feats = [
+        jnp.ones((b, 128 // 2 ** (i + 1), 128 // 2 ** (i + 1), c))
+        for i, c in enumerate(chans)
+    ]
+    disp = jnp.linspace(1.0, 0.01, s)[None]
+    dec = MPIDecoder()
+    vars_ = dec.init(jax.random.PRNGKey(0), feats, disp, train=True)
+    _, updates = dec.apply(vars_, feats, disp, train=True, mutable=["batch_stats"])
+    leaves_before = jax.tree_util.tree_leaves(vars_["batch_stats"])
+    leaves_after = jax.tree_util.tree_leaves(updates["batch_stats"])
+    assert any(
+        not np.allclose(a, b) for a, b in zip(leaves_before, leaves_after)
+    )
+
+
+def test_full_network_and_coarse_to_fine():
+    b, s, h, w = 1, 2, 128, 128
+    net = MPINetwork(num_layers=18, multires=4)
+    img = jnp.ones((b, h, w, 3)) * 0.5
+    disp = jnp.linspace(1.0, 0.01, s)[None]
+    vars_ = net.init(jax.random.PRNGKey(0), img, disp, train=False)
+
+    predictor = lambda im, d: net.apply(vars_, im, d, train=False)
+
+    # S_fine = 0: single pass, disparities unchanged
+    out, d_all = predict_mpi_coarse_to_fine(predictor, img, None, disp, 0)
+    assert d_all is disp and out[0].shape == (b, s, h, w, 4)
+
+    # S_fine > 0: union of sorted disparities, static output shape
+    xyz = jnp.ones((b, s, h, w, 3))
+    out, d_all = predict_mpi_coarse_to_fine(
+        predictor, img, xyz, disp, 2, key=jax.random.PRNGKey(1)
+    )
+    assert d_all.shape == (b, s + 2)
+    assert (jnp.diff(d_all, axis=1) <= 0).all()  # descending
+    assert out[0].shape == (b, s + 2, h, w, 4)
