@@ -1,0 +1,11 @@
+"""Losses and metrics (reference: network/ssim.py, network/layers.py,
+synthesis_task.py loss assembly)."""
+
+from mine_tpu.losses.ssim import ssim
+from mine_tpu.losses.smoothness import (
+    spatial_gradient,
+    edge_aware_loss,
+    edge_aware_loss_v2,
+)
+from mine_tpu.losses.metrics import psnr, compute_scale_factor, log_disparity_loss
+from mine_tpu.losses.lpips import lpips, load_lpips_params

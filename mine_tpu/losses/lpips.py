@@ -1,0 +1,100 @@
+"""LPIPS perceptual metric (VGG16 backbone), eval-only.
+
+Reference: synthesis_task.py:93 constructs `lpips.LPIPS(net="vgg")` and calls
+it on [0,1] images at val scale 0 only (:357-361). This module reimplements
+that metric as a pure JAX function over an explicit weight pytree:
+
+  * VGG16 features tapped after relu1_2 / relu2_2 / relu3_3 / relu4_3 /
+    relu5_3 (the `features` indices 4/9/16/23/30 the lpips package slices);
+  * per-tap channel-unit-normalization, squared diff, learned non-negative
+    1x1 "lin" weights, spatial mean, sum over taps;
+  * the lpips input scaling layer shift/scale constants.
+
+Weights cannot be downloaded in this environment (zero egress); convert them
+offline with tools/convert_lpips.py into an .npz and point
+`training.lpips_weights` at it. With no weights available the metric is
+disabled and reports 0.0 — the same value the reference logs for every
+non-val step (synthesis_task.py:357-363).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+# channels per VGG16 conv layer; "M" marks 2x2 maxpools
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512)
+# taps: feature index (in conv-only numbering) after which LPIPS reads features
+_TAP_AFTER_CONV = (1, 3, 6, 9, 12)  # relu1_2, relu2_2, relu3_3, relu4_3, relu5_3
+_TAP_CHANNELS = (64, 128, 256, 512, 512)
+
+# lpips.ScalingLayer constants (input nominally in [-1, 1])
+_SHIFT = np.array([-0.030, -0.088, -0.188], dtype=np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], dtype=np.float32)
+
+
+def load_lpips_params(path: str | None) -> dict | None:
+    """Load converted LPIPS weights (.npz from tools/convert_lpips.py).
+
+    Returns None when the path is unset/missing — callers must then skip the
+    metric (report 0.0), mirroring the reference's rank-gated LPIPS.
+    """
+    if not path or not os.path.exists(path):
+        return None
+    data = np.load(path)
+    n_conv = sum(1 for c in _VGG16_CFG if c != "M")
+    return {
+        "conv_w": [jnp.asarray(data[f"conv{i}_w"]) for i in range(n_conv)],
+        "conv_b": [jnp.asarray(data[f"conv{i}_b"]) for i in range(n_conv)],
+        "lin_w": [jnp.asarray(data[f"lin{i}_w"]) for i in range(len(_TAP_AFTER_CONV))],
+    }
+
+
+def _conv3x3(x: Array, w: Array, b: Array) -> Array:
+    return (
+        lax.conv_general_dilated(
+            x, w, (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        + b
+    )
+
+
+def _vgg_taps(params: dict, x: Array) -> list[Array]:
+    taps, conv_i = [], 0
+    for c in _VGG16_CFG:
+        if c == "M":
+            x = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+            continue
+        x = jnp.maximum(_conv3x3(x, params["conv_w"][conv_i], params["conv_b"][conv_i]), 0.0)
+        if conv_i in _TAP_AFTER_CONV:
+            taps.append(x)
+        conv_i += 1
+    return taps
+
+
+def lpips(params: dict, img1: Array, img2: Array) -> Array:
+    """Mean LPIPS distance between (B, H, W, 3) image batches.
+
+    Like the reference call site, images are passed through unchanged (the
+    reference feeds [0,1] images to an LPIPS configured for [-1,1] — a quirk
+    kept for metric comparability).
+    """
+    x1 = (img1 - _SHIFT) / _SCALE
+    x2 = (img2 - _SHIFT) / _SCALE
+    total = jnp.zeros((img1.shape[0],), dtype=jnp.float32)
+    for tap1, tap2, lin_w in zip(
+        _vgg_taps(params, x1), _vgg_taps(params, x2), params["lin_w"]
+    ):
+        n1 = tap1 * lax.rsqrt(jnp.sum(tap1**2, axis=-1, keepdims=True) + 1.0e-10)
+        n2 = tap2 * lax.rsqrt(jnp.sum(tap2**2, axis=-1, keepdims=True) + 1.0e-10)
+        diff = (n1 - n2) ** 2
+        # lin layer: non-negative per-channel weights, 1x1 conv to 1 channel
+        weighted = jnp.sum(diff * lin_w, axis=-1)  # (B, H, W)
+        total = total + jnp.mean(weighted, axis=(1, 2))
+    return jnp.mean(total)

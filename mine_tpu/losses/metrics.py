@@ -1,0 +1,39 @@
+"""Scalar image metrics and the sparse-point scale calibration.
+
+Reference: network/layers.py:48-51 (psnr), synthesis_task.py:214-223
+(compute_scale_factor), :296-339 (log-disparity point losses).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def psnr(img1: Array, img2: Array) -> Array:
+    """Mean PSNR over a batch of (B, H, W, C) images in [0, 1]
+    (layers.py:48-51)."""
+    mse = jnp.mean((img1 - img2) ** 2, axis=(1, 2, 3))
+    return jnp.mean(20.0 * jnp.log10(1.0 / jnp.sqrt(mse)))
+
+
+def compute_scale_factor(disparity_syn_pt3d: Array, pt3d_disp: Array) -> Array:
+    """Per-image scale between synthesized and COLMAP disparities
+    (synthesis_task.py:214-223): exp(mean(log d_syn - log d_gt)).
+
+    Both inputs (B, N, 1) or (B, N). Returns (B,).
+    """
+    log_ratio = jnp.log(disparity_syn_pt3d) - jnp.log(pt3d_disp)
+    return jnp.exp(jnp.mean(log_ratio.reshape(log_ratio.shape[0], -1), axis=1))
+
+
+def log_disparity_loss(
+    disparity_syn_pt3d: Array, pt3d_disp: Array, scale_factor: Array
+) -> Array:
+    """L1 in log space between scale-calibrated synthesized disparity and
+    sparse-point disparity (synthesis_task.py:325-339).
+
+    disparity_syn_pt3d / pt3d_disp: (B, N, 1); scale_factor: (B,).
+    """
+    scaled = disparity_syn_pt3d / scale_factor[:, None, None]
+    return jnp.mean(jnp.abs(jnp.log(scaled) - jnp.log(pt3d_disp)))

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from mine_tpu.models.embedder import positional_encode
+from mine_tpu.models.norm import SyncBatchNorm
 
 NUM_CH_DEC = (16, 32, 64, 128, 256)
 
@@ -65,10 +66,7 @@ class ConvBlock(nn.Module):
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
         x = Conv3x3(self.features, self.dtype)(x)
-        x = nn.BatchNorm(
-            use_running_average=not train, momentum=0.9, epsilon=1.0e-5,
-            dtype=self.dtype, axis_name=self.axis_name if train else None,
-        )(x)
+        x = SyncBatchNorm(self.axis_name, self.dtype)(x, train)
         return nn.elu(x)
 
 
@@ -85,10 +83,7 @@ class ConvBNLeaky(nn.Module):
         pad = (self.kernel - 1) // 2
         x = nn.Conv(self.features, (self.kernel, self.kernel), padding=pad,
                     use_bias=False, dtype=self.dtype)(x)
-        x = nn.BatchNorm(
-            use_running_average=not train, momentum=0.9, epsilon=1.0e-5,
-            dtype=self.dtype, axis_name=self.axis_name if train else None,
-        )(x)
+        x = SyncBatchNorm(self.axis_name, self.dtype)(x, train)
         return nn.leaky_relu(x, negative_slope=0.1)
 
 
@@ -131,7 +126,8 @@ class MPIDecoder(nn.Module):
             e = jnp.broadcast_to(embed[:, None, None, :], (b * s, h, w, embed.shape[-1]))
             return jnp.concatenate([tiled, e], axis=-1)
 
-        skips = [to_plane_batch(f) for f in features]
+        # the loop only consumes skips[0..3]; the deepest feature enters via x
+        skips = [to_plane_batch(f) for f in features[:-1]]
         x = to_plane_batch(x)
 
         # Rematerialization note: plane-axis memory pressure is handled one

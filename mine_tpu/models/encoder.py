@@ -23,6 +23,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax import Array
 
+from mine_tpu.models.norm import SyncBatchNorm
+
 IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 
@@ -39,24 +41,6 @@ def encoder_channels(num_layers: int) -> tuple[int, ...]:
     return base
 
 
-class _BatchNorm(nn.Module):
-    """BN matching torch defaults (momentum 0.1 -> flax 0.9, eps 1e-5) with
-    optional cross-replica stat reduction."""
-
-    axis_name: str | None = None
-    dtype: Any = jnp.float32
-
-    @nn.compact
-    def __call__(self, x: Array, train: bool) -> Array:
-        return nn.BatchNorm(
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1.0e-5,
-            dtype=self.dtype,
-            axis_name=self.axis_name if train else None,
-        )(x)
-
-
 class BasicBlock(nn.Module):
     features: int
     strides: int = 1
@@ -65,7 +49,7 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
-        bn = lambda: _BatchNorm(self.axis_name, self.dtype)
+        bn = lambda: SyncBatchNorm(self.axis_name, self.dtype)
         residual = x
         y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
                     padding=1, use_bias=False, dtype=self.dtype)(x)
@@ -90,7 +74,7 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array, train: bool) -> Array:
-        bn = lambda: _BatchNorm(self.axis_name, self.dtype)
+        bn = lambda: SyncBatchNorm(self.axis_name, self.dtype)
         squeeze = self.features // 4
         residual = x
         y = nn.Conv(squeeze, (1, 1), use_bias=False, dtype=self.dtype)(x)
@@ -137,7 +121,7 @@ class ResNetEncoder(nn.Module):
 
         x = nn.Conv(64, (7, 7), (2, 2), padding=3, use_bias=False,
                     dtype=self.dtype)(x)
-        x = _BatchNorm(self.axis_name, self.dtype)(x, train)
+        x = SyncBatchNorm(self.axis_name, self.dtype)(x, train)
         conv1_out = nn.relu(x)
 
         x = nn.max_pool(conv1_out, (3, 3), (2, 2), padding=((1, 1), (1, 1)))

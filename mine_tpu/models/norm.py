@@ -1,0 +1,33 @@
+"""Shared normalization layers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import Array
+
+
+class SyncBatchNorm(nn.Module):
+    """BN matching torch defaults (momentum 0.1 -> flax 0.9, eps 1e-5) with
+    optional cross-replica stat reduction over `axis_name`.
+
+    The reference reaches the same semantics by wrapping modules in torch
+    SyncBatchNorm at the task layer (synthesis_task.py:107-115); here it is a
+    property of the module. The axis_name is only applied in training — eval
+    uses running averages and must not emit collectives.
+    """
+
+    axis_name: str | None = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array, train: bool) -> Array:
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1.0e-5,
+            dtype=self.dtype,
+            axis_name=self.axis_name if train else None,
+        )(x)

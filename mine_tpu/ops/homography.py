@@ -26,7 +26,7 @@ from mine_tpu.ops.grid_sample import grid_sample_pixel
 # np (not jnp): a module-level jnp constant would initialize the JAX backend at
 # import time, committing the platform before callers can set JAX_PLATFORMS /
 # XLA_FLAGS. Broadcasts identically inside the einsum.
-PLANE_NORMAL = np.array([0.0, 0.0, 1.0])  # fronto-parallel planes
+PLANE_NORMAL = np.array([0.0, 0.0, 1.0], dtype=np.float32)  # fronto-parallel planes
 
 
 def build_plane_homography(

@@ -16,6 +16,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+# The env var alone is not enough: the axon TPU PJRT plugin in this image
+# registers itself regardless of JAX_PLATFORMS, and tests silently run on the
+# real chip (bf16 convs broke fp32 parity tests). The config override wins.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,146 @@
+"""Loss/metric parity tests against independent torch implementations.
+
+torch (cpu) is available in the image, so each reference formula is
+re-implemented here in torch from its mathematical definition (SURVEY.md §4's
+"golden-value" strategy) and compared with the JAX implementation.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from mine_tpu.losses import (
+    compute_scale_factor,
+    edge_aware_loss,
+    edge_aware_loss_v2,
+    log_disparity_loss,
+    psnr,
+    spatial_gradient,
+    ssim,
+)
+
+
+def _torch_ssim(img1, img2, window_size=11, sigma=1.5):
+    # standard gaussian-window SSIM (reference network/ssim.py formula)
+    x = torch.arange(window_size, dtype=torch.float64) - window_size // 2
+    g = torch.exp(-(x**2) / (2 * sigma**2))
+    g = (g / g.sum()).float()
+    w2 = (g[:, None] @ g[None, :])[None, None]
+    c = img1.shape[1]
+    w = w2.expand(c, 1, window_size, window_size)
+    pad = window_size // 2
+    conv = lambda t: F.conv2d(t, w, padding=pad, groups=c)
+    mu1, mu2 = conv(img1), conv(img2)
+    s11 = conv(img1 * img1) - mu1**2
+    s22 = conv(img2 * img2) - mu2**2
+    s12 = conv(img1 * img2) - mu1 * mu2
+    c1, c2 = 0.01**2, 0.03**2
+    m = ((2 * mu1 * mu2 + c1) * (2 * s12 + c2)) / ((mu1**2 + mu2**2 + c1) * (s11 + s22 + c2))
+    return m.mean()
+
+
+def _torch_sobel(x, normalized):
+    # kornia spatial_gradient semantics: sobel, replicate pad, /8 if normalized
+    kx = torch.tensor([[-1.0, 0, 1], [-2, 0, 2], [-1, 0, 1]])
+    if normalized:
+        kx = kx / 8.0
+    ky = kx.t()
+    c = x.shape[1]
+    k = torch.stack([kx, ky])[:, None].repeat(c, 1, 1, 1)  # (2C,1,3,3)
+    xp = F.pad(x, (1, 1, 1, 1), mode="replicate")
+    out = F.conv2d(xp, k, groups=c)  # (B, 2C, H, W)
+    b, _, h, w = out.shape
+    out = out.reshape(b, c, 2, h, w)
+    return out[:, :, 0], out[:, :, 1]
+
+
+def test_ssim_matches_torch(rng):
+    a = rng.uniform(size=(2, 24, 32, 3)).astype(np.float32)
+    b = np.clip(a + rng.normal(scale=0.1, size=a.shape), 0, 1).astype(np.float32)
+    got = float(ssim(jnp.asarray(a), jnp.asarray(b)))
+    want = float(
+        _torch_ssim(
+            torch.from_numpy(a).permute(0, 3, 1, 2),
+            torch.from_numpy(b).permute(0, 3, 1, 2),
+        )
+    )
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_ssim_identical_images_is_one(rng):
+    a = rng.uniform(size=(1, 16, 16, 3)).astype(np.float32)
+    assert float(ssim(jnp.asarray(a), jnp.asarray(a))) == pytest.approx(1.0, abs=1e-4)
+
+
+@pytest.mark.parametrize("normalized", [True, False])
+def test_spatial_gradient_matches_kornia_semantics(rng, normalized):
+    x = rng.uniform(size=(2, 12, 14, 3)).astype(np.float32)
+    gx, gy = spatial_gradient(jnp.asarray(x), normalized=normalized)
+    tx, ty = _torch_sobel(torch.from_numpy(x).permute(0, 3, 1, 2), normalized)
+    np.testing.assert_allclose(np.asarray(gx), tx.permute(0, 2, 3, 1).numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gy), ty.permute(0, 2, 3, 1).numpy(), atol=1e-5)
+
+
+def test_edge_aware_loss_matches_torch(rng):
+    img = rng.uniform(size=(2, 16, 20, 3)).astype(np.float32)
+    disp = rng.uniform(0.1, 1.0, size=(2, 16, 20, 1)).astype(np.float32)
+    gmin, grad_ratio = 0.02, 0.1
+
+    got = float(edge_aware_loss(jnp.asarray(img), jnp.asarray(disp), gmin, grad_ratio))
+
+    # torch re-derivation of layers.py:54-80
+    timg = torch.from_numpy(img).permute(0, 3, 1, 2)
+    tdisp = torch.from_numpy(disp).permute(0, 3, 1, 2)
+    gx, gy = _torch_sobel(timg, True)
+    gix = gx.abs().sum(1, keepdim=True)
+    giy = gy.abs().sum(1, keepdim=True)
+    emx = (gix / (gix.amax(dim=(1, 2, 3), keepdim=True) * grad_ratio)).clamp(max=1)
+    emy = (giy / (giy.amax(dim=(1, 2, 3), keepdim=True) * grad_ratio)).clamp(max=1)
+    dx, dy = _torch_sobel(tdisp, False)
+    ndx = F.instance_norm(dx.abs()) - gmin
+    ndy = F.instance_norm(dy.abs()) - gmin
+    want = float((ndx.clamp(min=0) * (1 - emx) + ndy.clamp(min=0) * (1 - emy)).mean())
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_edge_aware_loss_v2_matches_torch(rng):
+    img = rng.uniform(size=(2, 16, 20, 3)).astype(np.float32)
+    disp = rng.uniform(0.1, 1.0, size=(2, 16, 20, 1)).astype(np.float32)
+    got = float(edge_aware_loss_v2(jnp.asarray(img), jnp.asarray(disp)))
+
+    timg = torch.from_numpy(img).permute(0, 3, 1, 2)
+    tdisp = torch.from_numpy(disp).permute(0, 3, 1, 2)
+    md = tdisp.mean(2, True).mean(3, True)
+    d = tdisp / (md + 1e-7)
+    gdx = (d[..., :-1] - d[..., 1:]).abs()
+    gdy = (d[..., :-1, :] - d[..., 1:, :]).abs()
+    gix = (timg[..., :-1] - timg[..., 1:]).abs().mean(1, keepdim=True)
+    giy = (timg[..., :-1, :] - timg[..., 1:, :]).abs().mean(1, keepdim=True)
+    want = float((gdx * torch.exp(-gix)).mean() + (gdy * torch.exp(-giy)).mean())
+    assert got == pytest.approx(want, abs=1e-6)
+
+
+def test_psnr_known_value():
+    a = jnp.zeros((1, 8, 8, 3))
+    b = jnp.full((1, 8, 8, 3), 0.1)
+    # mse = 0.01 -> psnr = 20 log10(1/0.1) = 20
+    assert float(psnr(a, b)) == pytest.approx(20.0, abs=1e-3)
+
+
+def test_scale_factor_recovers_known_scale(rng):
+    gt = rng.uniform(0.5, 2.0, size=(2, 64, 1)).astype(np.float32)
+    syn = gt * np.array([2.0, 0.5], dtype=np.float32)[:, None, None]
+    sf = np.asarray(compute_scale_factor(jnp.asarray(syn), jnp.asarray(gt)))
+    np.testing.assert_allclose(sf, [2.0, 0.5], rtol=1e-5)
+
+
+def test_log_disparity_loss_zero_when_calibrated(rng):
+    gt = rng.uniform(0.5, 2.0, size=(2, 32, 1)).astype(np.float32)
+    syn = gt * 3.0
+    sf = jnp.full((2,), 3.0)
+    assert float(log_disparity_loss(jnp.asarray(syn), jnp.asarray(gt), sf)) == pytest.approx(
+        0.0, abs=1e-6
+    )

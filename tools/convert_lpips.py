@@ -1,0 +1,71 @@
+#!/usr/bin/env python3
+"""Offline converter: torch LPIPS(net='vgg') weights -> .npz for mine_tpu.
+
+The runtime framework never imports torch; this tool runs once, wherever the
+`lpips` package (or its two checkpoint files) is available, and produces the
+.npz consumed by mine_tpu.losses.lpips.load_lpips_params.
+
+Usage:
+  python tools/convert_lpips.py --out lpips_vgg.npz \
+      [--vgg-state vgg16_features.pth] [--lin-state lpips_vgg_lin.pth]
+
+With no --vgg-state/--lin-state it tries `import lpips` and extracts from the
+live module. Conv weights are transposed OIHW -> HWIO (NHWC convs); lin
+weights are the non-negative 1x1 conv kernels flattened to (C,).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def _save(out: str, conv_w, conv_b, lin_w) -> None:
+    arrays = {}
+    for i, (w, b) in enumerate(zip(conv_w, conv_b)):
+        arrays[f"conv{i}_w"] = np.transpose(w, (2, 3, 1, 0)).astype(np.float32)
+        arrays[f"conv{i}_b"] = b.astype(np.float32)
+    for i, w in enumerate(lin_w):
+        arrays[f"lin{i}_w"] = w.reshape(-1).astype(np.float32)
+    np.savez(out, **arrays)
+    print(f"wrote {out}: {len(conv_w)} convs, {len(lin_w)} lin layers")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--vgg-state", default=None, help="state_dict of torchvision vgg16().features")
+    ap.add_argument("--lin-state", default=None, help="lpips lin-layer checkpoint (vgg.pth)")
+    args = ap.parse_args()
+
+    import torch
+
+    if args.vgg_state and args.lin_state:
+        vgg_sd = torch.load(args.vgg_state, map_location="cpu")
+        lin_sd = torch.load(args.lin_state, map_location="cpu")
+        conv_keys = sorted(
+            {k.rsplit(".", 1)[0] for k in vgg_sd if k.endswith(".weight")},
+            key=lambda k: int(k.split(".")[-1]) if k.split(".")[-1].isdigit() else int(k.split(".")[0]),
+        )
+        conv_w = [vgg_sd[k + ".weight"].numpy() for k in conv_keys]
+        conv_b = [vgg_sd[k + ".bias"].numpy() for k in conv_keys]
+        lin_w = [lin_sd[k].numpy() for k in sorted(lin_sd) if "model" in k or "weight" in k]
+    else:
+        import lpips as lpips_pkg
+
+        model = lpips_pkg.LPIPS(net="vgg")
+        features = model.net.slice1, model.net.slice2, model.net.slice3, model.net.slice4, model.net.slice5
+        conv_w, conv_b = [], []
+        for sl in features:
+            for layer in sl:
+                if isinstance(layer, torch.nn.Conv2d):
+                    conv_w.append(layer.weight.detach().numpy())
+                    conv_b.append(layer.bias.detach().numpy())
+        lin_w = [lin.model[-1].weight.detach().numpy() for lin in model.lins]
+
+    _save(args.out, conv_w, conv_b, lin_w)
+
+
+if __name__ == "__main__":
+    main()
