@@ -1,0 +1,255 @@
+"""Typed, layered configuration.
+
+Reference behavior (train.py:33-59): flat dot-key YAML, merge order
+default -> dataset -> JSON overrides, where every overriding key must already
+exist in the default set. That UX is kept: config files are flat dot-key
+YAML, merged in the same order with the same must-pre-exist validation.
+
+Deliberately fixed from the reference (SURVEY.md §5.6): the merged result is
+an immutable dataclass tree, not a mutable dict god-object; no live handles
+(loggers/writers) ever live inside it; runtime-derived values (step, rank,
+workspace paths) are function arguments, not config mutations; and the
+undefined-key read `mpi.render_tgt_rgb_depth` (silently aliasing
+`mpi.is_bg_depth_inf`, synthesis_task.py:279) does not exist — there is one
+key, `mpi.is_bg_depth_inf`, used everywhere the reference meant it.
+
+New TPU-native keys live under `mesh.*` (device mesh layout) and a few
+`training.*`/`model.*` additions (dtype, remat, weight paths); defaults in
+mine_tpu/configs/default.yaml.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    name: str = "llff"
+    img_h: int = 384
+    img_w: int = 512
+    img_pre_downsample_ratio: float = 7.875
+    per_gpu_batch_size: int = 4  # per-device batch (reference key name kept)
+    num_tgt_views: int = 1
+    training_set_path: str = ""
+    val_set_path: str = ""
+    visible_point_count: int = 256
+    num_workers: int = 4
+    # dtu-only knobs (params_default.yaml:14-15)
+    rotation_pi_ratio: int = 3
+    is_exclude_views: bool = True
+
+
+@dataclass(frozen=True)
+class LRConfig:
+    backbone_lr: float = 1.0e-3
+    decoder_lr: float = 1.0e-3
+    decay_gamma: float = 0.1
+    decay_steps: tuple[int, ...] = (5, 10)  # epochs, MultiStep-style
+    weight_decay: float = 4.0e-5
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    num_layers: int = 50  # hardcoded in the reference (synthesis_task.py:69)
+    backbone_normalization: bool = True
+    decoder_normalization: bool = True
+    pos_encoding_multires: int = 10
+    imagenet_pretrained: bool = True
+    # path to a converted ResNet .npz (tools/convert_resnet.py); empty =>
+    # random init (the reference downloads torchvision weights instead,
+    # resnet_encoder.py:56-60 — no egress here)
+    pretrained_backbone_path: str = ""
+    # compute dtype for conv stacks: "bfloat16" (MXU-native) or "float32"
+    dtype: str = "bfloat16"
+    # wrap the decoder apply in jax.checkpoint to trade FLOPs for HBM
+    remat_decoder: bool = False
+
+
+@dataclass(frozen=True)
+class MPIConfig:
+    disparity_start: float = 1.0
+    disparity_end: float = 0.001
+    num_bins_coarse: int = 32
+    num_bins_fine: int = 0
+    is_bg_depth_inf: bool = False
+    valid_mask_threshold: float = 2.0
+    fix_disparity: bool = False
+    use_alpha: bool = False
+    sigma_dropout_rate: float = 0.0
+    # optional explicit bin-edge list, len == num_bins_coarse + 1
+    # (synthesis_task.py:37-52)
+    disparity_list: tuple[float, ...] = ()
+
+
+@dataclass(frozen=True)
+class LossConfig:
+    smoothness_lambda_v1: float = 0.0
+    smoothness_lambda_v2: float = 0.01
+    smoothness_gmin: float = 2.0
+    smoothness_grad_ratio: float = 0.1
+
+
+@dataclass(frozen=True)
+class TrainingConfig:
+    epochs: int = 15
+    eval_interval: int = 10000
+    fine_tune: bool = False
+    pretrained_checkpoint_path: str = ""
+    sample_interval: int = 30
+    src_rgb_blending: bool = True
+    use_multi_scale: bool = True
+    seed: int = 0
+    log_interval: int = 10  # reference hardcodes 10 (synthesis_task.py:638)
+    checkpoint_interval: int = 5000  # reference hardcodes 5000 (:645)
+    lpips_weights_path: str = ""  # .npz from tools/convert_lpips.py
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout (TPU-native; no reference analog — the reference's
+    only axis is NCCL data-parallel process count, train.py:66)."""
+
+    data_parallel: int = -1  # -1: all available devices
+    plane_parallel: int = 1  # S-axis sharding (SURVEY.md §5.7 stretch)
+
+
+@dataclass(frozen=True)
+class TestingConfig:
+    frames_apart: str = "random"
+
+
+@dataclass(frozen=True)
+class Config:
+    data: DataConfig = field(default_factory=DataConfig)
+    lr: LRConfig = field(default_factory=LRConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    mpi: MPIConfig = field(default_factory=MPIConfig)
+    loss: LossConfig = field(default_factory=LossConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    testing: TestingConfig = field(default_factory=TestingConfig)
+
+    def replace(self, **dot_key_values: Any) -> "Config":
+        """Functional update by dot-keys: cfg.replace(**{"mpi.num_bins_coarse": 8})."""
+        flat = to_flat_dict(self)
+        for k, v in dot_key_values.items():
+            if k not in flat:
+                raise KeyError(f"unknown config key: {k}")
+            flat[k] = v
+        return from_flat_dict(flat)
+
+
+_GROUPS = {f.name: f for f in dataclasses.fields(Config)}
+
+
+def _coerce(value: Any, target_type: Any, key: str) -> Any:
+    """Coerce YAML/JSON scalars into the dataclass field type."""
+    if target_type is float and isinstance(value, (int, float)):
+        return float(value)
+    if target_type is int:
+        if isinstance(value, bool):
+            raise TypeError(f"{key}: expected int, got bool")
+        if isinstance(value, float) and value.is_integer():
+            return int(value)
+        if isinstance(value, int):
+            return value
+        raise TypeError(f"{key}: expected int, got {value!r}")
+    if target_type is bool:
+        if isinstance(value, bool):
+            return value
+        raise TypeError(f"{key}: expected bool, got {value!r}")
+    if target_type is str:
+        return "" if value is None else str(value)
+    # tuple fields accept CSV strings (reference lr.decay_steps "60,90,120",
+    # train.py:57-58), lists, or tuples
+    if isinstance(target_type, str) and target_type.startswith("tuple"):
+        if isinstance(value, str):
+            value = [v for v in value.replace(" ", "").split(",") if v]
+        elem = float if "float" in target_type else int
+        return tuple(elem(v) for v in value)
+    return value
+
+
+def _field_type_name(f: dataclasses.Field) -> Any:
+    t = f.type
+    if isinstance(t, str):
+        if t.startswith("tuple"):
+            return t
+        return {"int": int, "float": float, "bool": bool, "str": str}.get(t, t)
+    return t
+
+
+def to_flat_dict(cfg: Config) -> dict[str, Any]:
+    """Config -> flat dot-key dict (the reference's native format)."""
+    flat: dict[str, Any] = {}
+    for gname in _GROUPS:
+        group = getattr(cfg, gname)
+        for f in dataclasses.fields(group):
+            flat[f"{gname}.{f.name}"] = getattr(group, f.name)
+    return flat
+
+
+def from_flat_dict(flat: dict[str, Any]) -> Config:
+    """Flat dot-key dict -> Config, with unknown-key and type validation."""
+    grouped: dict[str, dict[str, Any]] = {g: {} for g in _GROUPS}
+    for key, value in flat.items():
+        if "." not in key:
+            raise KeyError(f"config keys are dot-keys (group.name); got {key!r}")
+        gname, fname = key.split(".", 1)
+        if gname not in _GROUPS:
+            raise KeyError(f"unknown config group: {key!r}")
+        group_cls = _GROUPS[gname].default_factory  # type: ignore[union-attr]
+        fields = {f.name: f for f in dataclasses.fields(group_cls)}
+        if fname not in fields:
+            raise KeyError(f"unknown config key: {key!r}")
+        grouped[gname][fname] = _coerce(value, _field_type_name(fields[fname]), key)
+    return Config(**{
+        g: _GROUPS[g].default_factory(**kv)  # type: ignore[union-attr]
+        for g, kv in grouped.items()
+    })
+
+
+def load_config(
+    *yaml_paths: str,
+    overrides: dict[str, Any] | str | None = None,
+) -> Config:
+    """Layered load: later files override earlier ones; `overrides` (dict or
+    JSON string, the reference's --extra_config) overrides everything.
+
+    Mirrors train.py:33-47: every key in a later layer must already exist.
+    The first layer is the dataclass defaults, so all keys always pre-exist
+    exactly when they are valid keys.
+    """
+    flat = to_flat_dict(Config())
+    layers: list[dict[str, Any]] = []
+    for path in yaml_paths:
+        with open(path) as fh:
+            layers.append(yaml.safe_load(fh) or {})
+    if overrides:
+        if isinstance(overrides, str):
+            overrides = json.loads(overrides)
+        layers.append(overrides)
+    for layer in layers:
+        for key, value in layer.items():
+            if key not in flat:
+                raise KeyError(f"unknown config key: {key!r}")
+            flat[key] = value
+    return from_flat_dict(flat)
+
+
+def save_config(cfg: Config, path: str) -> None:
+    """Dump the merged config as flat dot-key YAML (the reference archives
+    params.yaml into the run workspace, train.py:49-54, :206-212; inference
+    re-reads it, image_to_video.py:275-277)."""
+    flat = {
+        k: (list(v) if isinstance(v, tuple) else v)
+        for k, v in to_flat_dict(cfg).items()
+    }
+    with open(path, "w") as fh:
+        yaml.safe_dump(flat, fh, sort_keys=True)

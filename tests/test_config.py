@@ -1,0 +1,82 @@
+"""Config system tests (reference behavior: train.py:33-59)."""
+
+import dataclasses
+import os
+
+import pytest
+
+from mine_tpu.config import (
+    Config,
+    from_flat_dict,
+    load_config,
+    save_config,
+    to_flat_dict,
+)
+
+CONFIGS = os.path.join(os.path.dirname(__file__), "..", "mine_tpu", "configs")
+
+
+def _cfg(*names, **kw):
+    return load_config(*(os.path.join(CONFIGS, n + ".yaml") for n in names), **kw)
+
+
+def test_default_yaml_round_trips_defaults():
+    # default.yaml must agree with the dataclass defaults key-for-key
+    assert _cfg("default") == Config()
+
+
+@pytest.mark.parametrize(
+    "name", ["llff", "nocs_llff", "objectron", "realestate", "kitti_raw", "flowers", "dtu"]
+)
+def test_all_dataset_configs_load(name):
+    cfg = _cfg("default", name)
+    assert cfg.data.img_h % 128 == 0 and cfg.data.img_w % 128 == 0
+    assert cfg.mpi.disparity_start > cfg.mpi.disparity_end > 0
+
+
+def test_layering_order():
+    cfg = _cfg("default", "llff")
+    assert cfg.data.per_gpu_batch_size == 2  # llff overrides default's 4
+    assert cfg.lr.decay_steps == (60, 90, 120)
+    assert cfg.training.sample_interval == 30  # untouched default survives
+
+
+def test_json_overrides_win():
+    cfg = _cfg("default", "llff", overrides='{"mpi.num_bins_coarse": 8}')
+    assert cfg.mpi.num_bins_coarse == 8
+    assert cfg.data.name == "llff"
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError, match="unknown config key"):
+        _cfg("default", overrides={"mpi.render_tgt_rgb_depth": True})
+
+
+def test_csv_decay_steps_accepted():
+    # reference configs carry decay steps as CSV strings (train.py:57-58)
+    cfg = _cfg("default", overrides={"lr.decay_steps": "60, 90,120"})
+    assert cfg.lr.decay_steps == (60, 90, 120)
+
+
+def test_type_validation():
+    with pytest.raises(TypeError):
+        _cfg("default", overrides={"training.epochs": "soon"})
+
+
+def test_frozen():
+    cfg = Config()
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        cfg.data.img_h = 1  # type: ignore[misc]
+
+
+def test_replace_and_flat_round_trip():
+    cfg = Config().replace(**{"mpi.num_bins_coarse": 4, "model.dtype": "float32"})
+    assert cfg.mpi.num_bins_coarse == 4
+    assert from_flat_dict(to_flat_dict(cfg)) == cfg
+
+
+def test_save_and_reload(tmp_path):
+    cfg = _cfg("default", "llff")
+    path = str(tmp_path / "params.yaml")
+    save_config(cfg, path)
+    assert load_config(path) == cfg
