@@ -1,0 +1,3 @@
+"""Data pipelines (reference: input_pipelines/)."""
+
+from mine_tpu.data.synthetic import make_synthetic_batch

@@ -39,18 +39,25 @@ _SCALE = np.array([0.458, 0.448, 0.450], dtype=np.float32)
 def load_lpips_params(path: str | None) -> dict | None:
     """Load converted LPIPS weights (.npz from tools/convert_lpips.py).
 
-    Returns None when the path is unset/missing — callers must then skip the
-    metric (report 0.0), mirroring the reference's rank-gated LPIPS.
+    Returns None when the path is unset — callers must then skip the metric
+    (report 0.0), mirroring the reference's rank-gated LPIPS. A path that is
+    set but does not exist raises (a typo must not silently zero the metric).
     """
-    if not path or not os.path.exists(path):
+    if not path:
         return None
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"LPIPS weights not found: {path!r}")
     data = np.load(path)
     n_conv = sum(1 for c in _VGG16_CFG if c != "M")
-    return {
+    params = {
         "conv_w": [jnp.asarray(data[f"conv{i}_w"]) for i in range(n_conv)],
         "conv_b": [jnp.asarray(data[f"conv{i}_b"]) for i in range(n_conv)],
         "lin_w": [jnp.asarray(data[f"lin{i}_w"]) for i in range(len(_TAP_AFTER_CONV))],
     }
+    for i, (w, c) in enumerate(zip(params["lin_w"], _TAP_CHANNELS)):
+        if w.shape != (c,):
+            raise ValueError(f"lin{i}_w shape {w.shape} != ({c},) in {path!r}")
+    return params
 
 
 def _conv3x3(x: Array, w: Array, b: Array) -> Array:
@@ -85,12 +92,12 @@ def lpips(params: dict, img1: Array, img2: Array) -> Array:
     reference feeds [0,1] images to an LPIPS configured for [-1,1] — a quirk
     kept for metric comparability).
     """
-    x1 = (img1 - _SHIFT) / _SCALE
-    x2 = (img2 - _SHIFT) / _SCALE
-    total = jnp.zeros((img1.shape[0],), dtype=jnp.float32)
-    for tap1, tap2, lin_w in zip(
-        _vgg_taps(params, x1), _vgg_taps(params, x2), params["lin_w"]
-    ):
+    b = img1.shape[0]
+    # one batched VGG pass over both images (halves the conv count vs two)
+    x = (jnp.concatenate([img1, img2], axis=0) - _SHIFT) / _SCALE
+    total = jnp.zeros((b,), dtype=jnp.float32)
+    for tap, lin_w in zip(_vgg_taps(params, x), params["lin_w"]):
+        tap1, tap2 = tap[:b], tap[b:]
         n1 = tap1 * lax.rsqrt(jnp.sum(tap1**2, axis=-1, keepdims=True) + 1.0e-10)
         n2 = tap2 * lax.rsqrt(jnp.sum(tap2**2, axis=-1, keepdims=True) + 1.0e-10)
         diff = (n1 - n2) ** 2

@@ -33,7 +33,10 @@ def log_disparity_loss(
     """L1 in log space between scale-calibrated synthesized disparity and
     sparse-point disparity (synthesis_task.py:325-339).
 
-    disparity_syn_pt3d / pt3d_disp: (B, N, 1); scale_factor: (B,).
+    disparity_syn_pt3d / pt3d_disp: (B, N, 1) or (B, N); scale_factor: (B,).
     """
-    scaled = disparity_syn_pt3d / scale_factor[:, None, None]
-    return jnp.mean(jnp.abs(jnp.log(scaled) - jnp.log(pt3d_disp)))
+    b = disparity_syn_pt3d.shape[0]
+    syn = disparity_syn_pt3d.reshape(b, -1)
+    gt = pt3d_disp.reshape(b, -1)
+    scaled = syn / scale_factor[:, None]
+    return jnp.mean(jnp.abs(jnp.log(scaled) - jnp.log(gt)))

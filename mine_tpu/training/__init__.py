@@ -1,0 +1,16 @@
+"""Training subsystem: state, optimizer, jitted step (reference:
+synthesis_task.py + train.py)."""
+
+from mine_tpu.training.state import TrainState
+from mine_tpu.training.optimizer import make_optimizer, learning_rates
+from mine_tpu.training.step import (
+    build_model,
+    make_disparity_list,
+    forward_coarse_to_fine,
+    render_novel_view,
+    loss_fcn_per_scale,
+    loss_fcn,
+    make_train_step,
+    make_eval_step,
+    init_state,
+)

@@ -1,0 +1,56 @@
+"""Optimizer and LR schedule.
+
+Reference: two-param-group Adam — backbone_lr / decoder_lr — with L2 weight
+decay folded into the gradient (torch Adam semantics, synthesis_task.py:85-89)
+and a per-epoch MultiStepLR decay (synthesis_task.py:118-120, stepped once per
+epoch at synthesis_task.py:685).
+
+optax construction: add_decayed_weights BEFORE scale_by_adam reproduces
+torch's grad += wd * p (not decoupled AdamW); multi_transform splits the two
+LR groups on the top-level param keys ('backbone' / 'decoder' — the module
+names in MPINetwork); the MultiStep schedule becomes a piecewise-constant
+schedule over global steps with epoch boundaries scaled by steps_per_epoch.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from mine_tpu.config import Config
+
+
+def _multistep(base_lr: float, decay_steps, gamma: float, steps_per_epoch: int):
+    boundaries = {int(e) * steps_per_epoch: gamma for e in decay_steps}
+    return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+def make_optimizer(cfg: Config, steps_per_epoch: int) -> optax.GradientTransformation:
+    def group(base_lr: float) -> optax.GradientTransformation:
+        return optax.chain(
+            optax.add_decayed_weights(cfg.lr.weight_decay),
+            optax.scale_by_adam(),  # b1/b2/eps defaults match torch Adam
+            optax.scale_by_learning_rate(
+                _multistep(base_lr, cfg.lr.decay_steps, cfg.lr.decay_gamma, steps_per_epoch)
+            ),
+        )
+
+    return optax.multi_transform(
+        {
+            "backbone": group(cfg.lr.backbone_lr),
+            "decoder": group(cfg.lr.decoder_lr),
+        },
+        param_labels=lambda params: {k: k for k in params},
+    )
+
+
+def learning_rates(cfg: Config, steps_per_epoch: int, step: int) -> dict[str, float]:
+    """Current LRs for logging (reference logs encoder lr,
+    synthesis_task.py:582-601)."""
+    return {
+        "backbone_lr": float(
+            _multistep(cfg.lr.backbone_lr, cfg.lr.decay_steps, cfg.lr.decay_gamma, steps_per_epoch)(step)
+        ),
+        "decoder_lr": float(
+            _multistep(cfg.lr.decoder_lr, cfg.lr.decay_steps, cfg.lr.decay_gamma, steps_per_epoch)(step)
+        ),
+    }

@@ -1,0 +1,34 @@
+"""Train state pytree.
+
+Replaces the reference's mutable SynthesisTask attributes (model refs,
+optimizer, global_step scattered across synthesis_task.py:65-170) with one
+immutable pytree. Unlike the reference checkpoint dict (backbone/decoder/
+optimizer only, synthesis_task.py:649-651 — step and RNG are lost on resume,
+SURVEY.md §5.3), everything needed for bitwise resume lives here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+from jax import Array
+
+
+class TrainState(struct.PyTreeNode):
+    step: Array  # scalar int32 global step
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: Array  # PRNG key consumed (fold_in step) by each train step
+
+    @classmethod
+    def create(cls, params, batch_stats, opt_state, rng) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+            rng=rng,
+        )

@@ -1,0 +1,452 @@
+"""The jitted training/eval step: forward, 4-scale loss graph, backward,
+cross-replica reduction, optimizer update — one compiled program.
+
+Reference graph: synthesis_task.py — network_forward (:420-453),
+loss_fcn_per_scale (:234-390), loss_fcn multi-scale aggregation (:392-418),
+render_novel_view (:455-494), train_epoch body (:627-635). There each piece
+is a separate eager call with DDP allreduce on backward; here the whole step
+(including `lax.pmean` of grads and BN stats sync via `axis_name`) is one XLA
+program, so warp/composite/loss all fuse around the conv stacks.
+
+Batch pytree (host loader contract, replacing init_data/set_data buffer
+staging at synthesis_task.py:172-212):
+  src_img, tgt_img: (B, H, W, 3) float32 in [0, 1]
+  k_src, k_tgt:     (B, 3, 3)
+  g_tgt_src:        (B, 4, 4)  src-frame -> tgt-frame rigid transform
+  pt3d_src, pt3d_tgt: (B, N, 3) sparse COLMAP points in each camera frame
+
+The reference's L==1 single-target assert (synthesis_task.py:203-204) is a
+memory ceiling, not a design choice; the batch carries one target view for
+parity, and more targets = bigger B at the loader level.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import Array, lax
+
+from mine_tpu import ops
+from mine_tpu.config import Config
+from mine_tpu.losses import (
+    compute_scale_factor,
+    edge_aware_loss,
+    edge_aware_loss_v2,
+    log_disparity_loss,
+    lpips as lpips_fn,
+    psnr,
+    ssim,
+)
+from mine_tpu.models import MPINetwork, predict_mpi_coarse_to_fine
+from mine_tpu.training.state import TrainState
+
+# datasets without metric COLMAP scale: disparity point losses are off and the
+# scale factor is 1 (synthesis_task.py:216-218, :312)
+NO_DISP_SUPERVISION = ("flowers", "kitti_raw", "dtu")
+
+
+def build_model(cfg: Config, axis_name: str | None = None) -> MPINetwork:
+    return MPINetwork(
+        num_layers=cfg.model.num_layers,
+        multires=cfg.model.pos_encoding_multires,
+        use_alpha=cfg.mpi.use_alpha,
+        sigma_dropout_rate=cfg.mpi.sigma_dropout_rate,
+        axis_name=axis_name,
+        dtype=jnp.bfloat16 if cfg.model.dtype == "bfloat16" else jnp.float32,
+    )
+
+
+def make_disparity_list(cfg: Config, key: Array, batch_size: int) -> Array:
+    """Per-step plane disparities, (B, S_coarse) descending
+    (synthesis_task.py:32-61)."""
+    m = cfg.mpi
+    has_list = len(m.disparity_list) == m.num_bins_coarse + 1
+    if m.fix_disparity:
+        if has_list:
+            edges = jnp.asarray(m.disparity_list, jnp.float32)
+            return jnp.broadcast_to(edges[1:][None], (batch_size, m.num_bins_coarse))
+        return ops.fixed_disparity_linspace(
+            batch_size, m.num_bins_coarse, m.disparity_start, m.disparity_end
+        )
+    if has_list:
+        return ops.uniform_disparity_from_bins(
+            key, batch_size, jnp.asarray(m.disparity_list, jnp.float32)
+        )
+    return ops.uniform_disparity_from_linspace_bins(
+        key, batch_size, m.num_bins_coarse, m.disparity_start, m.disparity_end
+    )
+
+
+def forward_coarse_to_fine(
+    cfg: Config,
+    model: MPINetwork,
+    params: Any,
+    batch_stats: Any,
+    src_img: Array,
+    k_src_inv: Array,
+    key_disparity: Array,
+    key_fine: Array | None = None,
+    key_dropout: Array | None = None,
+    train: bool = True,
+) -> tuple[dict[int, Array], Array, Any]:
+    """Full forward incl. optional coarse-to-fine plane refinement
+    (mpi_rendering.py:244-276). All shipped configs run the single-pass path
+    (num_bins_fine: 0, params_default.yaml:30)."""
+    b, h, w, _ = src_img.shape
+    disparity = make_disparity_list(cfg, key_disparity, b)
+
+    stats_cell = [batch_stats]
+
+    def predictor(img: Array, disp: Array) -> dict[int, Array]:
+        variables = {"params": params, "batch_stats": stats_cell[0]}
+        rngs = {"dropout": key_dropout} if key_dropout is not None else None
+
+        def apply(v, im, dsp):
+            if train:
+                return model.apply(v, im, dsp, True, rngs=rngs, mutable=["batch_stats"])
+            return model.apply(v, im, dsp, False, rngs=rngs), None
+
+        if cfg.model.remat_decoder:
+            apply = jax.checkpoint(apply)
+        out, updates = apply(variables, img, disp)
+        if updates is not None:
+            stats_cell[0] = updates["batch_stats"]
+        return out
+
+    if cfg.mpi.num_bins_fine > 0:
+        grid = ops.homogeneous_pixel_grid(h, w)
+        xyz_coarse = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
+        mpis, disparity = predict_mpi_coarse_to_fine(
+            predictor,
+            src_img,
+            xyz_coarse,
+            disparity,
+            cfg.mpi.num_bins_fine,
+            key=key_fine,
+            is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
+        )
+    else:
+        mpis = predictor(src_img, disparity)
+    return mpis, disparity, stats_cell[0]
+
+
+def render_novel_view(
+    cfg: Config,
+    mpi_rgb: Array,
+    mpi_sigma: Array,
+    disparity: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    scale_factor: Array | None = None,
+) -> dict[str, Array]:
+    """Warp + composite the source MPI into the target camera
+    (synthesis_task.py:455-494). scale_factor divides the pose translation
+    under stop_gradient (the reference's no_grad at :459-462)."""
+    if scale_factor is not None:
+        sf = lax.stop_gradient(scale_factor)
+        g_tgt_src = g_tgt_src.at[:, :3, 3].set(g_tgt_src[:, :3, 3] / sf[:, None])
+
+    h, w = mpi_rgb.shape[2], mpi_rgb.shape[3]
+    grid = ops.homogeneous_pixel_grid(h, w)
+    xyz_src = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
+    xyz_tgt = ops.get_tgt_xyz_from_plane_disparity(xyz_src, g_tgt_src)
+    tgt_rgb_syn, tgt_depth_syn, tgt_mask = ops.render_tgt_rgb_depth(
+        mpi_rgb,
+        mpi_sigma,
+        disparity,
+        xyz_tgt,
+        g_tgt_src,
+        k_src_inv,
+        k_tgt,
+        use_alpha=cfg.mpi.use_alpha,
+        is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
+    )
+    return {
+        "tgt_imgs_syn": tgt_rgb_syn,
+        "tgt_disparity_syn": 1.0 / tgt_depth_syn,
+        "tgt_mask_syn": tgt_mask,
+    }
+
+
+def _project_points(k: Array, pt3d: Array) -> Array:
+    """Camera-frame points -> pixel coords (synthesis_task.py:299-302)."""
+    uvw = jnp.einsum("bij,bnj->bni", k, pt3d)
+    return uvw[..., :2] / uvw[..., 2:3]
+
+
+def loss_fcn_per_scale(
+    cfg: Config,
+    scale: int,
+    batch: dict[str, Array],
+    mpi: Array,
+    disparity: Array,
+    scale_factor: Array | None,
+    is_val: bool,
+    lpips_params: dict | None,
+) -> tuple[dict[str, Array], dict[str, Array], Array]:
+    """One scale of the supervision graph (synthesis_task.py:234-390).
+
+    Returns (loss_dict, visualization_dict, scale_factor).
+    """
+    stride = 2**scale
+    # nearest downsample == strided slice (reference nn.Upsample(size=…),
+    # default nearest, synthesis_task.py:131-135: out[i] = in[i * 2^s])
+    src_img = batch["src_img"][:, ::stride, ::stride]
+    tgt_img = batch["tgt_img"][:, ::stride, ::stride]
+    b = src_img.shape[0]
+
+    k_src = ops.scale_intrinsics(batch["k_src"], scale)
+    k_tgt = ops.scale_intrinsics(batch["k_tgt"], scale)
+    k_src_inv = ops.inverse_3x3(k_src)
+
+    assert mpi.shape[2] == src_img.shape[1] and mpi.shape[3] == src_img.shape[2]
+    mpi_rgb = mpi[..., 0:3]
+    mpi_sigma = mpi[..., 3:4]
+
+    grid = ops.homogeneous_pixel_grid(src_img.shape[1], src_img.shape[2])
+    xyz_src = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
+    src_syn, src_depth, blend_weights, weights = ops.render(
+        mpi_rgb, mpi_sigma, xyz_src,
+        use_alpha=cfg.mpi.use_alpha, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
+    )
+    if cfg.training.src_rgb_blending:
+        # visible-from-src parts take the real pixels; occluded parts keep the
+        # network's rgb (synthesis_task.py:282-290)
+        mpi_rgb = blend_weights * src_img[:, None] + (1.0 - blend_weights) * mpi_rgb
+        src_syn, src_depth = ops.weighted_sum_mpi(
+            mpi_rgb, xyz_src, weights, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf
+        )
+    src_disparity_syn = 1.0 / src_depth
+
+    # sparse-point disparity supervision + scale calibration (:292-339)
+    disp_supervised = cfg.data.name not in NO_DISP_SUPERVISION
+    if disp_supervised:
+        src_pt_disp = 1.0 / batch["pt3d_src"][..., 2:3]  # (B, N, 1)
+        src_pt_disp_syn = ops.gather_pixel_by_pxpy(
+            src_disparity_syn, _project_points(k_src, batch["pt3d_src"])
+        )
+        if scale_factor is None:
+            scale_factor = compute_scale_factor(src_pt_disp_syn, src_pt_disp)
+        loss_disp_src = log_disparity_loss(src_pt_disp_syn, src_pt_disp, scale_factor)
+    else:
+        if scale_factor is None:
+            scale_factor = jnp.ones((b,), jnp.float32)
+        loss_disp_src = jnp.zeros(())
+
+    render_results = render_novel_view(
+        cfg, mpi_rgb, mpi_sigma, disparity,
+        batch["g_tgt_src"], k_src_inv, k_tgt, scale_factor=scale_factor,
+    )
+    tgt_syn = render_results["tgt_imgs_syn"]
+    tgt_disparity_syn = render_results["tgt_disparity_syn"]
+    tgt_mask = render_results["tgt_mask_syn"]
+
+    if disp_supervised:
+        tgt_pt_disp = 1.0 / batch["pt3d_tgt"][..., 2:3]
+        tgt_pt_disp_syn = ops.gather_pixel_by_pxpy(
+            tgt_disparity_syn, _project_points(k_tgt, batch["pt3d_tgt"])
+        )
+        loss_disp_tgt = log_disparity_loss(tgt_pt_disp_syn, tgt_pt_disp, scale_factor)
+    else:
+        loss_disp_tgt = jnp.zeros(())
+
+    # target-frame supervised terms (:341-356)
+    valid_mask = (tgt_mask >= cfg.mpi.valid_mask_threshold).astype(jnp.float32)
+    loss_rgb_tgt = jnp.mean(jnp.abs(tgt_syn - tgt_img) * valid_mask)
+    loss_ssim_tgt = 1.0 - ssim(tgt_syn, tgt_img)
+    loss_smooth_tgt = cfg.loss.smoothness_lambda_v1 * edge_aware_loss(
+        tgt_img, tgt_disparity_syn,
+        gmin=cfg.loss.smoothness_gmin, grad_ratio=cfg.loss.smoothness_grad_ratio,
+    )
+    loss_smooth_tgt_v2 = cfg.loss.smoothness_lambda_v2 * edge_aware_loss_v2(
+        tgt_img, tgt_disparity_syn
+    )
+    loss_smooth_src_v2 = cfg.loss.smoothness_lambda_v2 * edge_aware_loss_v2(
+        src_img, src_disparity_syn
+    )
+
+    # logged-only src terms, grad-blocked (reference torch.no_grad :312-323)
+    src_syn_ng = lax.stop_gradient(src_syn)
+    src_disp_ng = lax.stop_gradient(src_disparity_syn)
+    loss_rgb_src = jnp.mean(jnp.abs(src_syn_ng - src_img))
+    loss_ssim_src = 1.0 - ssim(src_syn_ng, src_img)
+    loss_smooth_src = edge_aware_loss(
+        src_img, src_disp_ng,
+        gmin=cfg.loss.smoothness_gmin, grad_ratio=cfg.loss.smoothness_grad_ratio,
+    )
+
+    # eval-only metrics (:357-363)
+    tgt_syn_ng = lax.stop_gradient(tgt_syn)
+    psnr_tgt = psnr(tgt_syn_ng, tgt_img)
+    if is_val and scale == 0 and lpips_params is not None:
+        lpips_tgt = lpips_fn(lpips_params, tgt_syn_ng, tgt_img)
+    else:
+        lpips_tgt = jnp.zeros(())
+
+    loss = (
+        loss_disp_tgt + loss_disp_src
+        + loss_rgb_tgt + loss_ssim_tgt
+        + loss_smooth_tgt
+        + loss_smooth_src_v2 + loss_smooth_tgt_v2
+    )
+
+    loss_dict = {
+        "loss": loss,
+        "loss_rgb_src": loss_rgb_src,
+        "loss_ssim_src": loss_ssim_src,
+        "loss_disp_pt3dsrc": loss_disp_src,
+        "loss_smooth_src": loss_smooth_src,
+        "loss_smooth_tgt": loss_smooth_tgt,
+        "loss_smooth_src_v2": loss_smooth_src_v2,
+        "loss_smooth_tgt_v2": loss_smooth_tgt_v2,
+        "loss_rgb_tgt": loss_rgb_tgt,
+        "loss_ssim_tgt": loss_ssim_tgt,
+        "lpips_tgt": lpips_tgt,
+        "psnr_tgt": psnr_tgt,
+        "loss_disp_pt3dtgt": loss_disp_tgt,
+    }
+    visualization = {
+        "src_disparity_syn": src_disparity_syn,
+        "tgt_disparity_syn": tgt_disparity_syn,
+        "tgt_imgs_syn": tgt_syn,
+        "tgt_mask_syn": tgt_mask,
+        "src_imgs_syn": src_syn,
+    }
+    return loss_dict, visualization, scale_factor
+
+
+def loss_fcn(
+    cfg: Config,
+    model: MPINetwork,
+    params: Any,
+    batch_stats: Any,
+    batch: dict[str, Array],
+    key: Array,
+    is_val: bool,
+    lpips_params: dict | None = None,
+    train: bool = True,
+) -> tuple[Array, dict[str, Array], dict[str, Array], Any]:
+    """Forward + all 4 scale losses + multi-scale aggregation
+    (synthesis_task.py:392-418).
+
+    Returns (total_loss, loss_dict, visualization_dict, new_batch_stats).
+    """
+    key_disp, key_fine, key_dropout = jax.random.split(key, 3)
+    k_src_inv = ops.inverse_3x3(batch["k_src"])
+    mpis, disparity, new_stats = forward_coarse_to_fine(
+        cfg, model, params, batch_stats, batch["src_img"], k_src_inv,
+        key_disparity=key_disp, key_fine=key_fine,
+        key_dropout=key_dropout if cfg.mpi.sigma_dropout_rate > 0 else None,
+        train=train,
+    )
+
+    scale_factor = None
+    loss_dicts, viz_dicts = [], []
+    for scale in range(4):
+        ld, vz, scale_factor = loss_fcn_per_scale(
+            cfg, scale, batch, mpis[scale], disparity, scale_factor,
+            is_val=is_val, lpips_params=lpips_params,
+        )
+        loss_dicts.append(ld)
+        viz_dicts.append(vz)
+
+    loss_dict = dict(loss_dicts[0])
+    total = loss_dict["loss"]
+    for scale in range(1, 4):
+        ld = loss_dicts[scale]
+        if cfg.training.use_multi_scale:
+            total = total + ld["loss_rgb_tgt"] + ld["loss_ssim_tgt"]
+        total = total + ld["loss_disp_pt3dsrc"] + ld["loss_disp_pt3dtgt"]
+        total = total + ld["loss_smooth_src_v2"] + ld["loss_smooth_tgt_v2"]
+    loss_dict["loss"] = total
+    return total, loss_dict, viz_dicts[0], new_stats
+
+
+def make_train_step(
+    cfg: Config,
+    model: MPINetwork,
+    tx: optax.GradientTransformation,
+    axis_name: str | None = None,
+) -> Callable[[TrainState, dict[str, Array]], tuple[TrainState, dict[str, Array]]]:
+    """Build the train-step function (one optimizer update,
+    synthesis_task.py:627-635 under jit).
+
+    With `axis_name`, the function expects to run inside shard_map/pmap over
+    that mesh axis: per-replica RNG folding, `lax.pmean` on grads and logged
+    losses (the DDP-allreduce + SyncBN equivalent, SURVEY.md §2.4).
+    """
+
+    def train_step(state: TrainState, batch: dict[str, Array]):
+        rng = jax.random.fold_in(state.rng, state.step)
+        if axis_name is not None:
+            rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+        def loss_fn(params):
+            total, loss_dict, _viz, new_stats = loss_fcn(
+                cfg, model, params, state.batch_stats, batch, rng,
+                is_val=False, train=True,
+            )
+            return total, (loss_dict, new_stats)
+
+        grads, (loss_dict, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = lax.pmean(grads, axis_name)
+            loss_dict = lax.pmean(loss_dict, axis_name)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        return new_state, loss_dict
+
+    return train_step
+
+
+def make_eval_step(
+    cfg: Config,
+    model: MPINetwork,
+    lpips_params: dict | None = None,
+    axis_name: str | None = None,
+):
+    """Eval step: same loss graph, eval-mode BN, no update
+    (synthesis_task.py:496-527). Runs on every replica (the reference runs
+    eval on rank 0 only — SURVEY.md §5.3 lists that as a gap, not a feature)."""
+
+    def eval_step(state: TrainState, batch: dict[str, Array], key: Array):
+        if axis_name is not None:
+            key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        _total, loss_dict, viz, _ = loss_fcn(
+            cfg, model, state.params, state.batch_stats, batch, key,
+            is_val=True, lpips_params=lpips_params, train=False,
+        )
+        if axis_name is not None:
+            loss_dict = lax.pmean(loss_dict, axis_name)
+        return loss_dict, viz
+
+    return eval_step
+
+
+def init_state(
+    cfg: Config,
+    model: MPINetwork,
+    tx: optax.GradientTransformation,
+    rng: Array,
+) -> TrainState:
+    """Initialize params/batch_stats/optimizer into a TrainState."""
+    key_init, key_state = jax.random.split(rng)
+    dummy_img = jnp.zeros((1, cfg.data.img_h, cfg.data.img_w, 3), jnp.float32)
+    dummy_disp = jnp.linspace(
+        cfg.mpi.disparity_start, cfg.mpi.disparity_end, cfg.mpi.num_bins_coarse
+    )[None, :]
+    variables = model.init(key_init, dummy_img, dummy_disp, True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = tx.init(params)
+    return TrainState.create(params, batch_stats, opt_state, key_state)
