@@ -71,10 +71,12 @@ def _sample_points(
     in the frame of a camera at cam_pos. (N, 3)."""
     n_near = n_points // 4
     n_far = n_points - n_near
-    # far points away from the near strip's shadow to dodge occlusion
+    # far points away from the near strip's shadow (|x| < 4*half_width at
+    # z=4) to dodge occlusion, but inside the fov: u = f x/z + cx < W needs
+    # |x| < z/(2*0.8) = 2.5 at the border, margin for the baseline shift
     sign = rng.choice([-1.0, 1.0], size=n_far)
-    x_far = sign * rng.uniform(_NEAR_HALF_WIDTH * 6.0, 2.5, size=n_far)
-    y_far = rng.uniform(-1.5, 1.5, size=n_far)
+    x_far = sign * rng.uniform(_NEAR_HALF_WIDTH * 6.0, 2.2, size=n_far)
+    y_far = rng.uniform(-1.4, 1.4, size=n_far)
     far = np.stack([x_far, y_far, np.full(n_far, FAR_DEPTH)], axis=-1)
     x_near = rng.uniform(-_NEAR_HALF_WIDTH, _NEAR_HALF_WIDTH, size=n_near)
     y_near = rng.uniform(-0.3, 0.3, size=n_near)

@@ -1,0 +1,21 @@
+"""SPMD parallelism: mesh construction, data-parallel step wrappers,
+plane-axis sharded compositing (reference: NCCL DDP, SURVEY.md §2.3-2.4)."""
+
+from mine_tpu.parallel.mesh import (
+    DATA_AXIS,
+    PLANE_AXIS,
+    init_multihost,
+    make_mesh,
+    batch_sharding,
+    shard_batch,
+)
+from mine_tpu.parallel.data_parallel import (
+    make_parallel_train_step,
+    make_parallel_eval_step,
+    replicate_state,
+)
+from mine_tpu.parallel.plane_sharding import (
+    sharded_alpha_composition,
+    sharded_plane_volume_rendering,
+    sharded_weighted_sum_mpi,
+)

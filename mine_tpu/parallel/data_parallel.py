@@ -1,0 +1,70 @@
+"""Data-parallel train/eval step wrappers over the device mesh.
+
+The reference's distributed story (DDP gradient allreduce + SyncBN +
+DistributedSampler, SURVEY.md §2.3) becomes: `shard_map` the train step over
+the mesh with the batch axis sharded on `data`, the loss averaged across
+replicas before differentiation and BN stats synced inside the step
+(mine_tpu/training/step.py), state replicated. One jit; XLA
+lowers the collectives onto ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from mine_tpu.config import Config
+from mine_tpu.models import MPINetwork
+from mine_tpu.parallel.mesh import DATA_AXIS
+from mine_tpu.training.step import make_eval_step, make_train_step
+from mine_tpu.training.state import TrainState
+
+_REPL = P()  # replicated
+_BATCH = P(DATA_AXIS)  # shard axis 0 over data
+
+
+def make_parallel_train_step(
+    cfg: Config, model: MPINetwork, tx: optax.GradientTransformation, mesh: Mesh
+) -> Callable:
+    """jit(shard_map(train_step)): state replicated, batch data-sharded.
+
+    The model must have been built with axis_name=DATA_AXIS (build_model) so
+    BN stats sync; the step pmeans the loss pre-grad and logged losses
+    post-grad (step.py).
+    """
+    step = make_train_step(cfg, model, tx, axis_name=DATA_AXIS)
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(_REPL, _BATCH),
+        out_specs=(_REPL, _REPL),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_parallel_eval_step(
+    cfg: Config,
+    model: MPINetwork,
+    mesh: Mesh,
+    lpips_params: dict | None = None,
+) -> Callable:
+    """jit(shard_map(eval_step)): losses pmean'd to replicated; per-replica
+    visualizations stay batch-sharded (gather only what gets logged)."""
+    step = make_eval_step(cfg, model, lpips_params=lpips_params, axis_name=DATA_AXIS)
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(_REPL, _BATCH, _REPL),
+        out_specs=(_REPL, _BATCH),
+    )
+    return jax.jit(sharded)
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place the train state replicated on every mesh device (the DDP initial
+    param broadcast, synthesis_task.py:110-115, done once, explicitly)."""
+    return jax.device_put(state, jax.sharding.NamedSharding(mesh, _REPL))

@@ -1,0 +1,95 @@
+"""Device mesh construction and multi-host bootstrap.
+
+Replaces the reference's NCCL process-group bootstrap (train.py:61-69,
+start_training.sh:75-83) with single-program SPMD over a
+`jax.sharding.Mesh`. Two axes:
+
+  data  — batch sharding (the reference's only axis: DDP data parallel)
+  plane — MPI plane (S) sharding, this model's sequence-parallel analog
+          (SURVEY.md §5.7): activations scale with B*S through decoder and
+          renderer, so S is the axis long-context pressure lives on.
+
+Collectives ride ICI within a slice and DCN across slices; XLA picks the
+transport — nothing here names a backend (vs NCCL hardcoding, train.py:66).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+PLANE_AXIS = "plane"
+
+
+def init_multihost(coordinator: str | None = None) -> None:
+    """Multi-host bootstrap (reference: torch.distributed.launch + NCCL TCP
+    rendezvous, start_training.sh:75-83). On TPU pods jax.distributed
+    discovers topology from the environment; coordinator is only needed for
+    manual setups.
+
+    MUST run before any other JAX call — jax.distributed can only initialize
+    while the backend is untouched, so this probes nothing (not even
+    jax.process_count()) before attempting it.
+    """
+    import warnings
+
+    try:
+        if coordinator:
+            jax.distributed.initialize(coordinator_address=coordinator)
+        else:
+            jax.distributed.initialize()
+    except RuntimeError as e:
+        msg = str(e)
+        if "already initialized" in msg:
+            return
+        if "must be called before" in msg:
+            # Backend already up: a caller-ordering bug for real multi-host
+            # jobs. Warn loudly instead of silently training N divergent
+            # single-host copies.
+            warnings.warn(
+                "init_multihost() called after the JAX backend was "
+                "initialized; continuing single-host. Call it first for "
+                f"multi-host runs. ({msg})",
+                stacklevel=2,
+            )
+            return
+        if coordinator is None:
+            # no cluster environment detected: plain single-host run
+            return
+        raise
+    except ValueError:
+        if coordinator is None:
+            return  # auto-detection found no cluster env: single-host
+        raise
+
+
+def make_mesh(data_parallel: int = -1, plane_parallel: int = 1) -> Mesh:
+    """Build the (data, plane) mesh. data_parallel=-1 takes every device not
+    claimed by plane_parallel."""
+    devices = np.asarray(jax.devices())
+    n = devices.size
+    if plane_parallel < 1 or n % plane_parallel:
+        raise ValueError(f"plane_parallel={plane_parallel} must divide {n} devices")
+    if data_parallel == -1:
+        data_parallel = n // plane_parallel
+    if data_parallel * plane_parallel != n:
+        raise ValueError(
+            f"mesh {data_parallel}x{plane_parallel} != {n} available devices"
+        )
+    return Mesh(devices.reshape(data_parallel, plane_parallel), (DATA_AXIS, PLANE_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for host batches: batch axis over `data`, replicated over
+    `plane`."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def shard_batch(mesh: Mesh, batch: dict) -> dict:
+    """device_put a host batch with the batch axis sharded over `data`
+    (replaces the reference's per-process DistributedSampler slicing,
+    train.py:88 — here one logical batch spans the mesh)."""
+    sharding = batch_sharding(mesh)
+    return jax.device_put(batch, sharding)

@@ -1,0 +1,128 @@
+"""Plane-axis (S) sharded MPI compositing — the sequence-parallel analog.
+
+The reference brute-forces the S axis ("memory consumption is huge, only one
+supervision is allowed", synthesis_task.py:203-204): every (B, S, H, W, C)
+tensor lives whole on one GPU. Here S shards across the `plane` mesh axis and
+compositing — a prefix product over planes — runs as a two-level scan
+(SURVEY.md §5.7): local cumprod on each device's plane chunk, then one tiny
+`all_gather` of per-device products to build the cross-device exclusive
+prefix. The heavy (B, S_local, H, W) tensors never move; only (B, H, W)
+per-device products cross the ICI — this is the project's honest analog of
+ring attention's "ship statistics, not activations".
+
+All functions here expect to run INSIDE shard_map with the plane axis named
+`axis_name`; plane order follows mesh position (device p owns planes
+[p*S_local, (p+1)*S_local), near planes on low indices, same descending-
+disparity convention as ops/mpi_render.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+from mine_tpu.ops.mpi_render import _BG_DIST, _shifted_exclusive
+
+
+def _exclusive_device_prefix(local_total: Array, axis_name: str) -> Array:
+    """Exclusive product of per-device totals over the plane axis.
+
+    local_total: (...) this device's product over its local planes.
+    Returns (...) product over all devices strictly before this one.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    gathered = lax.all_gather(local_total, axis_name)  # (n, ...)
+    mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * local_total.ndim)
+    return jnp.prod(jnp.where(mask, gathered, 1.0), axis=0)
+
+
+def sharded_alpha_composition(
+    alpha: Array, value: Array, axis_name: str
+) -> tuple[Array, Array]:
+    """Plane-sharded over-compositing (unsharded twin: ops.alpha_composition).
+
+    alpha: (B, S_local, H, W, 1); value: (B, S_local, H, W, C).
+    Returns composed (B, H, W, C) — full sum, replicated across the plane
+    axis — and this device's local weights (B, S_local, H, W, 1).
+    """
+    trans_local = jnp.cumprod(1.0 - alpha, axis=1)
+    prefix = _exclusive_device_prefix(trans_local[:, -1], axis_name)
+    preserve = _shifted_exclusive(trans_local) * prefix[:, None]
+    weights = alpha * preserve
+    composed = lax.psum(jnp.sum(value * weights, axis=1), axis_name)
+    return composed, weights
+
+
+def _halo_next_first_plane(x: Array, axis_name: str, fill: Array) -> Array:
+    """First plane of the NEXT device's chunk (for inter-plane distances).
+    The last device receives `fill`. x: (B, S_local, ...) -> (B, ...)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # shift first-plane slices one device towards lower plane indices
+    recv = lax.ppermute(x[:, 0], axis_name, [(p, (p - 1) % n) for p in range(n)])
+    return jnp.where(idx == n - 1, fill, recv)
+
+
+def sharded_plane_volume_rendering(
+    rgb: Array,
+    sigma: Array,
+    xyz: Array,
+    axis_name: str,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """Plane-sharded NeRF-style volume rendering (unsharded twin:
+    ops.plane_volume_rendering; reference mpi_rendering.py:42-67).
+
+    rgb/xyz: (B, S_local, H, W, 3); sigma: (B, S_local, H, W, 1).
+    Returns (rgb_out (B,H,W,3), depth_out (B,H,W,1)) — psum-replicated —
+    plus local transmittance and weights (B, S_local, H, W, 1).
+    """
+    # inter-plane distances need one halo plane from the next device
+    xyz_next = _halo_next_first_plane(xyz, axis_name, xyz[:, -1])  # fill unused
+    xyz_ext = jnp.concatenate([xyz, xyz_next[:, None]], axis=1)
+    diff = xyz_ext[:, 1:] - xyz_ext[:, :-1]
+    dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)  # (B, S_local, H, W, 1)
+    # the globally-last plane gets the background pseudo-distance
+    n = lax.axis_size(axis_name)
+    is_last_device = lax.axis_index(axis_name) == n - 1
+    s_local = dist.shape[1]
+    last_mask = (jnp.arange(s_local) == s_local - 1).reshape(1, s_local, 1, 1, 1)
+    dist = jnp.where(jnp.logical_and(is_last_device, last_mask), _BG_DIST, dist)
+
+    transparency = jnp.exp(-sigma * dist)
+    alpha = 1.0 - transparency
+
+    trans_local = jnp.cumprod(transparency + 1.0e-6, axis=1)
+    prefix = _exclusive_device_prefix(trans_local[:, -1], axis_name)
+    transparency_acc = _shifted_exclusive(trans_local) * prefix[:, None]
+    weights = transparency_acc * alpha
+
+    weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
+    rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)
+    z_term = lax.psum(jnp.sum(weights * xyz[..., 2:3], axis=1), axis_name)
+    if is_bg_depth_inf:
+        depth_out = z_term + (1.0 - weights_sum) * 1000.0
+    else:
+        depth_out = z_term / (weights_sum + 1.0e-5)
+    return rgb_out, depth_out, transparency_acc, weights
+
+
+def sharded_weighted_sum_mpi(
+    rgb: Array,
+    xyz: Array,
+    weights: Array,
+    axis_name: str,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array]:
+    """Plane-sharded expectation under compositing weights (unsharded twin:
+    ops.weighted_sum_mpi)."""
+    weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
+    rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)
+    z_term = lax.psum(jnp.sum(weights * xyz[..., 2:3], axis=1), axis_name)
+    if is_bg_depth_inf:
+        depth_out = z_term + (1.0 - weights_sum) * 1000.0
+    else:
+        depth_out = z_term / (weights_sum + 1.0e-5)
+    return rgb_out, depth_out

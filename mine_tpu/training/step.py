@@ -5,7 +5,8 @@ Reference graph: synthesis_task.py — network_forward (:420-453),
 loss_fcn_per_scale (:234-390), loss_fcn multi-scale aggregation (:392-418),
 render_novel_view (:455-494), train_epoch body (:627-635). There each piece
 is a separate eager call with DDP allreduce on backward; here the whole step
-(including `lax.pmean` of grads and BN stats sync via `axis_name`) is one XLA
+(including the cross-replica loss averaging that induces the gradient
+reduction, and BN stats sync via `axis_name`) is one XLA
 program, so warp/composite/loss all fuse around the conv stacks.
 
 Batch pytree (host loader contract, replacing init_data/set_data buffer
@@ -375,9 +376,11 @@ def make_train_step(
     """Build the train-step function (one optimizer update,
     synthesis_task.py:627-635 under jit).
 
-    With `axis_name`, the function expects to run inside shard_map/pmap over
-    that mesh axis: per-replica RNG folding, `lax.pmean` on grads and logged
-    losses (the DDP-allreduce + SyncBN equivalent, SURVEY.md §2.4).
+    With `axis_name`, the function expects to run inside shard_map over that
+    mesh axis: per-replica RNG folding, the scalar loss pmean'd before
+    differentiation (which makes AD emit the global-batch gradient — the
+    DDP-allreduce + SyncBN equivalent, SURVEY.md §2.4), logged losses
+    pmean'd after.
     """
 
     def train_step(state: TrainState, batch: dict[str, Array]):
@@ -390,11 +393,19 @@ def make_train_step(
                 cfg, model, params, state.batch_stats, batch, rng,
                 is_val=False, train=True,
             )
+            # The cross-replica gradient reduction happens HERE, by averaging
+            # the scalar loss before differentiation — not by pmean-ing grads
+            # after. Under shard_map's varying-manual-axes semantics the
+            # cotangent of the replicated params is automatically psum'd
+            # across the axis, so a post-grad pmean would be an identity on an
+            # already-summed (n-times-too-large) gradient. Averaging the loss
+            # makes AD produce exactly the global-batch gradient.
+            if axis_name is not None:
+                total = lax.pmean(total, axis_name)
             return total, (loss_dict, new_stats)
 
         grads, (loss_dict, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
         if axis_name is not None:
-            grads = lax.pmean(grads, axis_name)
             loss_dict = lax.pmean(loss_dict, axis_name)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

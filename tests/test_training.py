@@ -66,11 +66,13 @@ def test_multistep_lr_schedule():
 
 def test_synthetic_batch_geometry():
     batch = make_synthetic_batch(1, 64, 64, n_points=32, seed=3)
-    # points reproject into the image
-    k = batch["k_src"][0]
-    uvw = batch["pt3d_src"][0] @ k.T
-    uv = uvw[:, :2] / uvw[:, 2:]
     assert np.all(batch["pt3d_src"][0][:, 2] > 0)
+    # sparse points reproject inside both views (they stand in for COLMAP
+    # points, which are by construction visible in the images)
+    for pts, k_key in ((batch["pt3d_src"][0], "k_src"), (batch["pt3d_tgt"][0], "k_tgt")):
+        uvw = pts @ batch[k_key][0].T
+        uv = uvw[:, :2] / uvw[:, 2:]
+        assert np.all(uv >= 0) and np.all(uv[:, 0] < 64) and np.all(uv[:, 1] < 64)
     # depth map contains exactly the two plane depths
     assert set(np.unique(batch["src_depth"][0])) == {1.0, 4.0}
     # tgt points are src points shifted by the (known) baseline
