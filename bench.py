@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Benchmark: LLFF-recipe training throughput (imgs/sec) on one chip.
+
+Workload = the reference's LLFF recipe (BASELINE.md): 384x512 images,
+S=32 planes, ResNet-50 encoder, per-device batch 2, full 4-scale loss +
+backward + Adam update per step, bf16 conv stacks. Data is the synthetic
+two-view scene (procedural — measures compute, not disk).
+
+Baseline denominator: the reference repo publishes no throughput anywhere
+(SURVEY.md §6); the north star is >=4x PyTorch-V100 imgs/sec. Until the
+reference recipe is timed on a real V100 (BASELINE.md action item), we use
+an ESTIMATE of 3.0 imgs/sec for PyTorch on one V100-16GB (batch 2 at
+~0.6-0.7 s/step for ResNet-50 + BxS=64 U-Net decoder + 4-scale grid_sample
+supervision), so vs_baseline = imgs_per_sec / 3.0.
+
+Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+V100_IMGS_PER_SEC_ESTIMATE = 3.0
+BATCH = 2
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.config import Config
+    from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.training import build_model, init_state, make_optimizer, make_train_step
+
+    def build(remat: bool):
+        cfg = Config().replace(**{
+            "data.name": "llff",
+            "data.img_h": 384, "data.img_w": 512,
+            "data.per_gpu_batch_size": BATCH,
+            "mpi.num_bins_coarse": 32,
+            "loss.smoothness_gmin": 0.8,
+            "loss.smoothness_grad_ratio": 0.2,
+            "model.remat_decoder": remat,
+        })
+        model = build_model(cfg)
+        tx = make_optimizer(cfg, steps_per_epoch=100)
+        state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+        step = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
+        return state, step
+
+    batch_np = make_synthetic_batch(BATCH, 384, 512, n_points=256, seed=0)
+    batch_np.pop("src_depth")
+    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+
+    state, step = build(remat=False)
+    try:
+        for _ in range(WARMUP_STEPS):
+            state, loss_dict = step(state, batch)
+        jax.block_until_ready(loss_dict["loss"])
+    except Exception as e:  # noqa: BLE001 - HBM OOM => retry with remat
+        if "RESOURCE_EXHAUSTED" not in str(e).upper().replace(" ", "_"):
+            raise
+        print(f"# OOM without remat, retrying with remat_decoder ({e})",
+              file=sys.stderr)
+        state, step = build(remat=True)
+        for _ in range(WARMUP_STEPS):
+            state, loss_dict = step(state, batch)
+        jax.block_until_ready(loss_dict["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, loss_dict = step(state, batch)
+    jax.block_until_ready(loss_dict["loss"])
+    elapsed = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * MEASURE_STEPS / elapsed
+    print(json.dumps({
+        "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 3),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / V100_IMGS_PER_SEC_ESTIMATE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

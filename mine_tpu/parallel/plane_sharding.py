@@ -83,13 +83,19 @@ def sharded_plane_volume_rendering(
     xyz_next = _halo_next_first_plane(xyz, axis_name, xyz[:, -1])  # fill unused
     xyz_ext = jnp.concatenate([xyz, xyz_next[:, None]], axis=1)
     diff = xyz_ext[:, 1:] - xyz_ext[:, :-1]
-    dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)  # (B, S_local, H, W, 1)
-    # the globally-last plane gets the background pseudo-distance
+    # the globally-last plane's diff is the zero halo fill; its dist is
+    # overwritten with the background pseudo-distance below, but the zero must
+    # be replaced BEFORE the norm — d||v||/dv at v=0 is 0/0, and jnp.where
+    # only masks the forward value, so a zero diff would send NaN cotangents
+    # into xyz on the backward pass
     n = lax.axis_size(axis_name)
     is_last_device = lax.axis_index(axis_name) == n - 1
-    s_local = dist.shape[1]
+    s_local = diff.shape[1]
     last_mask = (jnp.arange(s_local) == s_local - 1).reshape(1, s_local, 1, 1, 1)
-    dist = jnp.where(jnp.logical_and(is_last_device, last_mask), _BG_DIST, dist)
+    bg_mask = jnp.logical_and(is_last_device, last_mask)
+    diff = jnp.where(bg_mask, 1.0, diff)
+    dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)  # (B, S_local, H, W, 1)
+    dist = jnp.where(bg_mask, _BG_DIST, dist)
 
     transparency = jnp.exp(-sigma * dist)
     alpha = 1.0 - transparency
@@ -99,13 +105,9 @@ def sharded_plane_volume_rendering(
     transparency_acc = _shifted_exclusive(trans_local) * prefix[:, None]
     weights = transparency_acc * alpha
 
-    weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
-    rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)
-    z_term = lax.psum(jnp.sum(weights * xyz[..., 2:3], axis=1), axis_name)
-    if is_bg_depth_inf:
-        depth_out = z_term + (1.0 - weights_sum) * 1000.0
-    else:
-        depth_out = z_term / (weights_sum + 1.0e-5)
+    rgb_out, depth_out = sharded_weighted_sum_mpi(
+        rgb, xyz, weights, axis_name, is_bg_depth_inf
+    )
     return rgb_out, depth_out, transparency_acc, weights
 
 

@@ -5,8 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import lax
-from jax import shard_map
+from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mine_tpu.config import Config
@@ -21,7 +20,7 @@ from mine_tpu.parallel import (
     sharded_alpha_composition,
     sharded_plane_volume_rendering,
 )
-from mine_tpu.training import build_model, init_state, make_optimizer, make_train_step
+from mine_tpu.training import build_model, init_state, make_train_step
 
 
 def _plane_mesh(n):
@@ -87,6 +86,34 @@ def test_sharded_volume_rendering_matches_unsharded(rng, is_bg_depth_inf):
         np.testing.assert_allclose(
             np.asarray(g), np.asarray(w_), rtol=2e-5, atol=atol, err_msg=name
         )
+
+
+def test_sharded_volume_rendering_grads_finite(rng):
+    """Regression: the zero halo diff on the globally-last plane used to send
+    NaN through the norm's backward into the xyz cotangent (the jnp.where on
+    dist masks the forward value only)."""
+    b, s, h, w = 1, 8, 4, 5
+    rgb = jnp.asarray(rng.uniform(size=(b, s, h, w, 3)).astype(np.float32))
+    sigma = jnp.asarray(rng.uniform(0, 3, size=(b, s, h, w, 1)).astype(np.float32))
+    z = np.broadcast_to(np.linspace(1, 4, s)[None, :, None, None, None], (b, s, h, w, 1))
+    xyz = jnp.asarray(
+        np.concatenate([np.zeros((b, s, h, w, 2)), z], -1).astype(np.float32)
+    )
+    mesh = _plane_mesh(4)
+
+    def loss(r, sg, x):
+        rgb_out, depth_out, _, _ = sharded_plane_volume_rendering(r, sg, x, "plane")
+        return lax.pmean(jnp.sum(rgb_out) + jnp.sum(depth_out), "plane")
+
+    grad_fn = shard_map(
+        jax.grad(loss, argnums=(0, 1, 2)),
+        mesh=mesh,
+        in_specs=(P(None, "plane"),) * 3,
+        out_specs=(P(None, "plane"),) * 3,
+    )
+    grads = jax.jit(grad_fn)(rgb, sigma, xyz)
+    for g, name in zip(grads, ["rgb", "sigma", "xyz"]):
+        assert bool(jnp.all(jnp.isfinite(g))), f"non-finite grad in {name}"
 
 
 @pytest.mark.slow
