@@ -1,3 +1,3 @@
 """Data pipelines (reference: input_pipelines/)."""
 
-from mine_tpu.data.synthetic import make_synthetic_batch
+from mine_tpu.data.synthetic import SyntheticDataset, make_synthetic_batch

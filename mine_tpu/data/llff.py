@@ -1,0 +1,213 @@
+"""LLFF / NOCS posed-multi-view pipelines over COLMAP sparse models.
+
+Reference: input_pipelines/llff/nerf_dataset.py (LLFF) and nocs_dataset.py
+(NOCS variant: center-crop + first-50-images cap). Behaviors kept:
+
+  * scene layout <root>/<scene>/{sparse/0, images_<ratio>[_val]/}
+  * eager RAM load of the (small) scene set at construction
+    (nerf_dataset.py:61-98)
+  * K built from the single SIMPLE_RADIAL camera with per-axis ratios
+    between stored-image and target resolution (nerf_dataset.py:152-163)
+  * per-image COLMAP points transformed to the camera frame; per-item random
+    point subsets; train targets sampled uniformly from the same scene, val
+    target = deterministic neighbor (nerf_dataset.py:199-236)
+
+Deliberate fixes (cited deviations):
+  * NOCS center-crop now shifts the principal point by the crop offset; the
+    reference computes its ratios from the post-crop size so the crop never
+    reaches K (nocs_dataset.py:96-109) — a geometry error, not a feature.
+  * batches come out in this framework's channel-last contract
+    (training/step.py) with G_tgt_src precomputed, replacing the reference's
+    collate + set_data staging (nerf_dataset.py:15-30,
+    synthesis_task.py:187-212).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+from PIL import Image
+
+from mine_tpu.config import Config
+from mine_tpu.data import colmap
+
+
+@dataclass
+class PosedImage:
+    scene: str
+    img: np.ndarray  # (H, W, 3) f32 in [0, 1]
+    k: np.ndarray  # (3, 3) f32
+    g_cam_world: np.ndarray  # (4, 4) f32
+    pts_cam: np.ndarray  # (N, 3) f32 camera-frame COLMAP points
+
+
+def _load_image(path: str, img_hw: tuple[int, int], center_crop: tuple[int, int] | None):
+    """PIL load; optional center crop; bicubic resize to (H, W). Returns
+    (img f32 HWC, stored (w, h), crop offset (left, top))."""
+    img = Image.open(path).convert("RGB")
+    left = top = 0
+    if center_crop is not None:
+        ch, cw = center_crop
+        left = (img.width - cw) // 2
+        top = (img.height - ch) // 2
+        img = img.crop((left, top, left + cw, top + ch))
+    w, h = img.width, img.height
+    img = img.resize((img_hw[1], img_hw[0]), Image.BICUBIC)
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    return arr, (w, h), (left, top)
+
+
+def load_scene(
+    scene_dir: str,
+    image_folder: str,
+    img_hw: tuple[int, int],
+    pre_downsample_ratio: float,
+    center_crop: tuple[int, int] | None = None,
+    max_images: int | None = None,
+    min_points: int = 1,
+) -> list[PosedImage]:
+    """Load every posed image of one COLMAP scene (nerf_dataset.py:61-98)."""
+    cameras, images, points3d = colmap.read_model(os.path.join(scene_dir, "sparse/0"))
+    assert len(cameras) == 1, f"{scene_dir}: expected a single shared camera"
+    cam = next(iter(cameras.values()))
+
+    out: list[PosedImage] = []
+    for img_id in sorted(images):
+        if max_images is not None and len(out) >= max_images:
+            break
+        meta = images[img_id]
+        path = os.path.join(scene_dir, image_folder, meta.name)
+        if not os.path.exists(path):
+            continue
+        arr, (w, h), (left, top) = _load_image(path, img_hw, center_crop)
+
+        # stored image is the original divided by pre_downsample_ratio; the
+        # COLMAP camera lives at original resolution (nerf_dataset.py:152-158)
+        ratio_x = w * pre_downsample_ratio / img_hw[1]
+        ratio_y = h * pre_downsample_ratio / img_hw[0]
+        f = cam.params[0]
+        cx, cy = cam.params[1], cam.params[2]
+        # principal point shifts by the crop offset at stored resolution
+        # (deviation from nocs_dataset.py:96-109 — see module docstring)
+        cx -= left * pre_downsample_ratio
+        cy -= top * pre_downsample_ratio
+        k = np.array(
+            [[f / ratio_x, 0.0, cx / ratio_x],
+             [0.0, f / ratio_y, cy / ratio_y],
+             [0.0, 0.0, 1.0]],
+            dtype=np.float32,
+        )
+
+        r = colmap.qvec2rotmat(meta.qvec).astype(np.float32)
+        t = meta.tvec.astype(np.float32)
+        g = np.eye(4, dtype=np.float32)
+        g[:3, :3] = r
+        g[:3, 3] = t
+
+        tracked = meta.point3d_ids >= 0
+        world = np.stack(
+            [points3d[pid].xyz for pid in meta.point3d_ids[tracked]]
+        ) if tracked.any() else np.zeros((0, 3))
+        pts_cam = (world @ r.T + t).astype(np.float32)  # (N, 3)
+        if len(pts_cam) < min_points:
+            raise ValueError(
+                f"{path}: {len(pts_cam)} tracked points < required {min_points}"
+            )
+        out.append(PosedImage(os.path.basename(scene_dir), arr, k, g, pts_cam))
+    return out
+
+
+class LLFFDataset:
+    """Loader-protocol dataset: steps_per_epoch + epoch(n) batch iterator.
+
+    Replaces torch Dataset + DistributedSampler + DataLoader + collate
+    (train.py:76-132): one logical global batch per step, sharded onto the
+    mesh by the loop.
+    """
+
+    def __init__(self, cfg: Config, split: str, global_batch: int):
+        self.cfg = cfg
+        self.split = split
+        self.global_batch = global_batch
+        is_val = split == "val"
+        self.is_val = is_val
+        self.rng_seed = cfg.training.seed + (991 if is_val else 0)
+
+        ratio = cfg.data.img_pre_downsample_ratio
+        folder = "images" if ratio is None or ratio <= 1 else f"images_{ratio}"
+        if is_val:
+            folder += "_val"
+        is_nocs = cfg.data.name == "nocs_llff"
+        crop = (384, 640) if is_nocs else None
+
+        root = cfg.data.training_set_path
+        self.images: list[PosedImage] = []
+        for scene in sorted(os.listdir(root)):
+            scene_dir = os.path.join(root, scene)
+            if not os.path.isdir(scene_dir):
+                continue
+            self.images.extend(
+                load_scene(
+                    scene_dir, folder, (cfg.data.img_h, cfg.data.img_w),
+                    1.0 if is_nocs else ratio,
+                    center_crop=crop,
+                    # reference NOCS caps at the first ~50 images
+                    # (nocs_dataset.py:71-75)
+                    max_images=51 if is_nocs else None,
+                    min_points=cfg.data.visible_point_count,
+                )
+            )
+        if not self.images:
+            raise FileNotFoundError(f"no posed images under {root!r} ({folder})")
+        # scene -> global indices (nerf_dataset.py scene_to_indices)
+        self.scene_indices: dict[str, list[int]] = {}
+        for i, im in enumerate(self.images):
+            self.scene_indices.setdefault(im.scene, []).append(i)
+        for scene, idxs in self.scene_indices.items():
+            if len(idxs) < 2:
+                raise ValueError(f"scene {scene} has {len(idxs)} image(s); need >= 2")
+
+    def __len__(self) -> int:
+        return max(len(self.images) // self.global_batch, 1)
+
+    def _example(self, src_idx: int, rng: np.random.Generator) -> dict[str, np.ndarray]:
+        src = self.images[src_idx]
+        scene_idxs = [i for i in self.scene_indices[src.scene] if i != src_idx]
+        if self.is_val:
+            # deterministic neighbor (nerf_dataset.py:205-208)
+            tgt_idx = scene_idxs[(src_idx + 1) % len(scene_idxs) - 1]
+        else:
+            tgt_idx = int(rng.choice(scene_idxs))
+        tgt = self.images[tgt_idx]
+
+        n_pt = self.cfg.data.visible_point_count
+        src_pts = src.pts_cam[rng.choice(len(src.pts_cam), n_pt, replace=False)]
+        tgt_pts = tgt.pts_cam[rng.choice(len(tgt.pts_cam), n_pt, replace=False)]
+
+        # G_tgt_src maps src-camera coords to tgt-camera coords
+        # (reference builds G_src_tgt then inverts at set_data,
+        # nerf_dataset.py:219-221 + synthesis_task.py:211)
+        g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
+        return {
+            "src_img": src.img,
+            "tgt_img": tgt.img,
+            "k_src": src.k,
+            "k_tgt": tgt.k,
+            "g_tgt_src": g_tgt_src.astype(np.float32),
+            "pt3d_src": src_pts,
+            "pt3d_tgt": tgt_pts,
+        }
+
+    def epoch(self, epoch: int):
+        rng = np.random.default_rng((self.rng_seed, epoch))
+        order = rng.permutation(len(self.images))
+        for start in range(0, len(self) * self.global_batch, self.global_batch):
+            idxs = order[start : start + self.global_batch]
+            if len(idxs) < self.global_batch:  # drop_last
+                break
+            examples = [self._example(int(i), rng) for i in idxs]
+            yield {
+                k: np.stack([e[k] for e in examples]) for k in examples[0]
+            }

@@ -1,0 +1,177 @@
+"""Objectron pipeline (zubair-irshad fork addition).
+
+Reference: input_pipelines/objectron.py. Scene layout:
+  <root>/<scene>/<scene>_metadata.pickle   poses (c2w), focal, c, RT, scale,
+                                           all_scene_points
+  <root>/<scene>/masks_3[_val]/*.png       frame list (mask name encodes the
+                                           image name: "<prefix>_<img>.png")
+  <root>/<scene>/images_3[_val]/<img>
+
+Behaviors kept: the frame list is mask-driven (objectron.py:72-74); the pose
+is inv(c2w @ ADJUST) with the axis-adjust matrix (objectron.py:53-57, :110);
+images are BGR->RGB, rotated 90° CCW, center-cropped to 384x640
+(objectron.py:130-135); K comes per-frame from metadata focal/c
+(objectron.py:150-158); one shared world point cloud per scene, transformed
+per frame (objectron.py:117-147); targets sampled within a ±10-frame window
+(objectron.py:176-186), deterministic neighbor for val; ~150-frame cap per
+scene (objectron.py:122-123). The debug prints in the reference __getitem__
+(objectron.py:233-236) are, naturally, not kept.
+
+Deviation: the center crop shifts K's principal point by the crop offset (the
+reference leaves K untouched, same geometry error as its NOCS crop).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+from dataclasses import dataclass
+
+import numpy as np
+from PIL import Image
+
+from mine_tpu.config import Config
+
+ADJUST = np.array(
+    [[0.0, 1.0, 0.0, 0.0],
+     [1.0, 0.0, 0.0, 0.0],
+     [0.0, 0.0, -1.0, 0.0],
+     [0.0, 0.0, 0.0, 1.0]]
+)
+CROP_HW = (384, 640)
+FRAME_WINDOW = 10
+MAX_FRAMES_PER_SCENE = 150
+
+
+@dataclass
+class ObjectronFrame:
+    scene: str
+    img: np.ndarray  # (H, W, 3) f32
+    k: np.ndarray  # (3, 3) f32
+    g_cam_world: np.ndarray  # (4, 4) f32
+    pts_cam: np.ndarray  # (N, 3) f32
+
+
+def _load_frame_image(path: str, img_hw: tuple[int, int]):
+    """RGB load + 90° CCW rotate + center crop; returns (img, crop offsets at
+    cropped-orientation resolution)."""
+    img = Image.open(path).convert("RGB")
+    img = img.transpose(Image.ROTATE_90)
+    ch, cw = CROP_HW
+    left = max((img.width - cw) // 2, 0)
+    top = max((img.height - ch) // 2, 0)
+    img = img.crop((left, top, min(left + cw, img.width), min(top + ch, img.height)))
+    if (img.height, img.width) != img_hw:
+        img = img.resize((img_hw[1], img_hw[0]), Image.BICUBIC)
+    return np.asarray(img, dtype=np.float32) / 255.0, (left, top)
+
+
+def load_objectron_scene(
+    scene_dir: str, split: str, img_hw: tuple[int, int]
+) -> list[ObjectronFrame]:
+    scene = os.path.basename(scene_dir.rstrip("/"))
+    suffix = "_val" if split == "val" else ""
+    meta_path = os.path.join(scene_dir, f"{scene}_metadata.pickle")
+    with open(meta_path, "rb") as fh:
+        meta = pickle.load(fh)
+
+    poses_c2w = np.asarray(meta["poses"])
+    focals = np.asarray(meta["focal"])
+    centers = np.asarray(meta["c"])
+    world_pts = np.asarray(meta["all_scene_points"], dtype=np.float64)
+
+    mask_files = sorted(glob.glob(os.path.join(scene_dir, f"masks_3{suffix}", "*.png")))
+    frames: list[ObjectronFrame] = []
+    for seg_name in mask_files[: MAX_FRAMES_PER_SCENE + 1]:
+        img_name = os.path.basename(seg_name).split("_")[1]
+        img_path = os.path.join(scene_dir, f"images_3{suffix}", img_name)
+        if not os.path.exists(img_path):
+            continue
+        frame_idx = int(img_name.split(".")[0])
+
+        c2w = np.squeeze(poses_c2w[frame_idx])
+        g_cam_world = np.linalg.inv(c2w @ ADJUST)
+
+        img, (left, top) = _load_frame_image(img_path, img_hw)
+        fx, fy = focals[frame_idx][0], focals[frame_idx][1]
+        cx, cy = centers[frame_idx][0], centers[frame_idx][1]
+        k = np.array(
+            [[fx, 0.0, cx - left], [0.0, fy, cy - top], [0.0, 0.0, 1.0]],
+            dtype=np.float32,
+        )
+
+        homo = np.concatenate([world_pts, np.ones((len(world_pts), 1))], axis=1)
+        cam = (g_cam_world @ homo.T).T
+        pts_cam = (cam[:, :3] / cam[:, 3:4]).astype(np.float32)
+
+        frames.append(
+            ObjectronFrame(scene, img, k, g_cam_world.astype(np.float32), pts_cam)
+        )
+    return frames
+
+
+class ObjectronDataset:
+    """Loader-protocol dataset over Objectron scene directories."""
+
+    def __init__(self, cfg: Config, split: str, global_batch: int):
+        self.cfg = cfg
+        self.split = split
+        self.is_val = split == "val"
+        self.global_batch = global_batch
+        self.rng_seed = cfg.training.seed + (991 if self.is_val else 0)
+
+        root = cfg.data.training_set_path
+        self.frames: list[ObjectronFrame] = []
+        for scene in sorted(os.listdir(root)):
+            scene_dir = os.path.join(root, scene)
+            if not os.path.isdir(scene_dir):
+                continue
+            self.frames.extend(
+                load_objectron_scene(scene_dir, split, (cfg.data.img_h, cfg.data.img_w))
+            )
+        if not self.frames:
+            raise FileNotFoundError(f"no objectron frames under {root!r}")
+        self.scene_indices: dict[str, list[int]] = {}
+        for i, fr in enumerate(self.frames):
+            self.scene_indices.setdefault(fr.scene, []).append(i)
+
+    def __len__(self) -> int:
+        return max(len(self.frames) // self.global_batch, 1)
+
+    def _example(self, src_idx: int, rng: np.random.Generator) -> dict[str, np.ndarray]:
+        src = self.frames[src_idx]
+        # ±FRAME_WINDOW same-scene candidates (objectron.py:176-186)
+        neighbors = [
+            i for i in self.scene_indices[src.scene]
+            if i != src_idx and abs(i - src_idx) <= FRAME_WINDOW
+        ]
+        if self.is_val:
+            tgt_idx = neighbors[(src_idx + 1) % len(neighbors) - 1]
+        else:
+            tgt_idx = int(rng.choice(neighbors))
+        tgt = self.frames[tgt_idx]
+
+        n_pt = self.cfg.data.visible_point_count
+        src_sel = rng.choice(len(src.pts_cam), n_pt, replace=len(src.pts_cam) < n_pt)
+        tgt_sel = rng.choice(len(tgt.pts_cam), n_pt, replace=len(tgt.pts_cam) < n_pt)
+        g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
+        return {
+            "src_img": src.img,
+            "tgt_img": tgt.img,
+            "k_src": src.k,
+            "k_tgt": tgt.k,
+            "g_tgt_src": g_tgt_src.astype(np.float32),
+            "pt3d_src": src.pts_cam[src_sel],
+            "pt3d_tgt": tgt.pts_cam[tgt_sel],
+        }
+
+    def epoch(self, epoch: int):
+        rng = np.random.default_rng((self.rng_seed, epoch))
+        order = rng.permutation(len(self.frames))
+        for start in range(0, len(self) * self.global_batch, self.global_batch):
+            idxs = order[start : start + self.global_batch]
+            if len(idxs) < self.global_batch:
+                break
+            examples = [self._example(int(i), rng) for i in idxs]
+            yield {k: np.stack([e[k] for e in examples]) for k in examples[0]}

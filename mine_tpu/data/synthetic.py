@@ -85,6 +85,43 @@ def _sample_points(
     return (pts - cam_pos[None, :]).astype(np.float32)
 
 
+class SyntheticDataset:
+    """Procedural dataset speaking the loader protocol (steps_per_epoch +
+    epoch(n) iterator of batch pytrees). Zero disk footprint; every batch is
+    a fresh scene, deterministic in (seed, epoch, step)."""
+
+    def __init__(
+        self,
+        height: int,
+        width: int,
+        global_batch: int,
+        steps_per_epoch: int = 50,
+        n_points: int = 256,
+        seed: int = 0,
+    ):
+        self.height = height
+        self.width = width
+        self.global_batch = global_batch
+        self.steps_per_epoch = steps_per_epoch
+        self.n_points = n_points
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def epoch(self, epoch: int):
+        for step in range(self.steps_per_epoch):
+            batch = make_synthetic_batch(
+                self.global_batch,
+                self.height,
+                self.width,
+                n_points=self.n_points,
+                seed=self.seed + epoch * 1_000_003 + step,
+            )
+            batch.pop("src_depth")
+            yield batch
+
+
 def make_synthetic_batch(
     batch_size: int,
     height: int,

@@ -32,9 +32,17 @@ def init_multihost(coordinator: str | None = None) -> None:
     MUST run before any other JAX call — jax.distributed can only initialize
     while the backend is untouched, so this probes nothing (not even
     jax.process_count()) before attempting it.
+
+    Opt-in: runs only when `coordinator` is given or MINE_TPU_MULTIHOST is
+    set. jax.distributed.initialize()'s auto-detection BLOCKS waiting for
+    peers on some single-chip environments (observed with tunneled TPU
+    metadata), so it must never fire implicitly on single-host runs.
     """
+    import os
     import warnings
 
+    if coordinator is None and not os.environ.get("MINE_TPU_MULTIHOST"):
+        return
     try:
         if coordinator:
             jax.distributed.initialize(coordinator_address=coordinator)

@@ -1,0 +1,74 @@
+"""Training CLI: `python -m mine_tpu.train --config mine_tpu/configs/llff.yaml`.
+
+Reference entry point: start_training.sh + train.py (torch.distributed.launch
+multi-process spawn). Here there is no launcher layer — one process per host,
+SPMD over the mesh; the same command works single-chip, v4-8, or multi-host
+(with jax.distributed auto-detection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def build_dataset(cfg, split: str, global_batch: int):
+    """Dataset factory (reference train.py:72-164 get_dataset)."""
+    name = cfg.data.name
+    if name == "synthetic":
+        from mine_tpu.data import SyntheticDataset
+
+        return SyntheticDataset(
+            cfg.data.img_h, cfg.data.img_w, global_batch,
+            steps_per_epoch=12 if split == "train" else 2,
+            n_points=cfg.data.visible_point_count,
+            seed=cfg.training.seed + (0 if split == "train" else 10_000),
+        )
+    if name in ("llff", "nocs_llff"):
+        from mine_tpu.data.llff import LLFFDataset
+
+        return LLFFDataset(cfg, split, global_batch)
+    if name == "objectron":
+        from mine_tpu.data.objectron import ObjectronDataset
+
+        return ObjectronDataset(cfg, split, global_batch)
+    raise NotImplementedError(
+        f"dataset {name!r} has no pipeline yet (reference parity: train.py:161-162 "
+        "raises NotImplementedError for realestate10k/flowers/kitti_raw/dtu too)"
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--config", action="append", default=[],
+        help="YAML config layer(s), later override earlier; the defaults "
+        "layer is always implied first",
+    )
+    parser.add_argument(
+        "--extra_config", default=None,
+        help="JSON dict of final overrides (reference train.py --extra_config)",
+    )
+    parser.add_argument("--workspace", default="workspace/run")
+    parser.add_argument(
+        "--profile-steps", type=int, default=0,
+        help="trace this many steps with jax.profiler into <workspace>/profile",
+    )
+    args = parser.parse_args(argv)
+
+    # init_multihost must run before any backend-touching call; Trainer does
+    # it first thing, so config parsing is the only work before this point.
+    from mine_tpu.config import load_config
+    from mine_tpu.training.loop import Trainer
+
+    default = os.path.join(os.path.dirname(__file__), "configs", "default.yaml")
+    cfg = load_config(default, *args.config, overrides=args.extra_config)
+
+    trainer = Trainer(cfg, args.workspace, profile_steps=args.profile_steps)
+    train_ds = build_dataset(cfg, "train", trainer.global_batch)
+    val_ds = build_dataset(cfg, "val", trainer.global_batch)
+    trainer.fit(train_ds, val_ds)
+
+
+if __name__ == "__main__":
+    main()

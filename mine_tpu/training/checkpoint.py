@@ -1,0 +1,69 @@
+"""Checkpoint save/restore via orbax.
+
+Reference mechanism (synthesis_task.py:645-679, utils.py:40-67): rank-0 torch
+.pth of backbone/decoder/optimizer; step and RNG are NOT saved, so a resumed
+run restarts schedules from zero (SURVEY.md §5.3-5.4). Here the whole
+TrainState (params, batch_stats, optimizer state, step, PRNG key) is one
+orbax pytree; a restore resumes bitwise where training stopped — the
+preemption-tolerance TPU pods require. The config travels next to the
+checkpoints as params.yaml (the reference's checkpoint+config pairing,
+image_to_video.py:275-277).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from mine_tpu.config import Config, load_config, save_config
+
+_LATEST_EVERY = "state"  # item name inside each step directory
+
+
+def checkpoint_manager(
+    workspace: str, max_to_keep: int = 3, keep_period: int | None = None
+) -> ocp.CheckpointManager:
+    """Manager writing to <workspace>/checkpoints/<step>/.
+
+    max_to_keep bounds the rolling 'latest' set (reference keeps one rolling
+    checkpoint_latest.pth); keep_period pins every k-th step forever (the
+    reference's immutable checkpoint_%012d at eval intervals).
+    """
+    path = os.path.abspath(os.path.join(workspace, "checkpoints"))
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        keep_period=keep_period,
+        create=True,
+    )
+    return ocp.CheckpointManager(path, options=options)
+
+
+def save(manager: ocp.CheckpointManager, state: Any, step: int) -> None:
+    manager.save(step, args=ocp.args.StandardSave(state))
+
+
+def restore(manager: ocp.CheckpointManager, state_template: Any) -> tuple[Any, int]:
+    """Restore the newest step, shaped like state_template.
+    Returns (state, step); (template, 0) when no checkpoint exists."""
+    step = manager.latest_step()
+    if step is None:
+        return state_template, 0
+    state = manager.restore(step, args=ocp.args.StandardRestore(state_template))
+    return state, step
+
+
+def save_paired_config(cfg: Config, workspace: str) -> None:
+    """Archive the merged config into the workspace (train.py:206-212)."""
+    save_config(cfg, os.path.join(workspace, "params.yaml"))
+
+
+def load_paired_config(workspace: str) -> Config:
+    """Inference re-reads the archived config (image_to_video.py:275-277)."""
+    return load_config(os.path.join(workspace, "params.yaml"))
+
+
+def wait_until_finished(manager: ocp.CheckpointManager) -> None:
+    manager.wait_until_finished()

@@ -1,0 +1,179 @@
+"""The training loop: epochs, logging, eval, checkpointing.
+
+Reference: synthesis_task.py train/train_epoch/run_eval (:609-690, :496-527)
++ train.py main/train (:167-216). Differences by design (SURVEY.md §5.3-5.5,
+§7.5): eval runs on every replica (not rank 0 only); checkpoints carry
+step/optimizer/PRNG for bitwise resume and auto-resume from the workspace;
+every log line carries imgs/sec; loss fetches happen once per log interval so
+steps stay fully async on device.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable
+
+import jax
+import numpy as np
+
+from mine_tpu.config import Config
+from mine_tpu.losses import load_lpips_params
+from mine_tpu.parallel import (
+    DATA_AXIS,
+    init_multihost,
+    make_mesh,
+    make_parallel_eval_step,
+    make_parallel_train_step,
+    replicate_state,
+    shard_batch,
+)
+from mine_tpu.training import checkpoint as ckpt
+from mine_tpu.training.optimizer import learning_rates, make_optimizer
+from mine_tpu.training.step import build_model, init_state
+from mine_tpu.utils import (
+    AverageMeter,
+    MetricWriter,
+    StepTimer,
+    make_logger,
+    normalize_disparity_for_vis,
+)
+
+LOSS_KEYS = (
+    "loss", "loss_rgb_src", "loss_ssim_src", "loss_disp_pt3dsrc",
+    "loss_smooth_src", "loss_smooth_tgt", "loss_smooth_src_v2",
+    "loss_smooth_tgt_v2", "loss_rgb_tgt", "loss_ssim_tgt", "lpips_tgt",
+    "psnr_tgt", "loss_disp_pt3dtgt",
+)
+
+
+class Trainer:
+    """Owns mesh, model, state, and the jitted steps; `fit` runs epochs."""
+
+    def __init__(self, cfg: Config, workspace: str, profile_steps: int = 0):
+        init_multihost()
+        self.cfg = cfg
+        self.workspace = workspace
+        self.profile_steps = profile_steps
+        self.mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
+        self.logger = make_logger(workspace)
+        self.writer = MetricWriter(workspace)
+        self.model = build_model(cfg, axis_name=DATA_AXIS)
+        self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
+        if jax.process_index() == 0:
+            os.makedirs(workspace, exist_ok=True)
+            ckpt.save_paired_config(cfg, workspace)
+
+    def fit(self, train_ds: Any, val_ds: Any | None = None) -> dict[str, float]:
+        cfg = self.cfg
+        steps_per_epoch = len(train_ds)
+        tx = make_optimizer(cfg, steps_per_epoch)
+        state = init_state(cfg, self.model, tx, jax.random.PRNGKey(cfg.training.seed))
+
+        manager = ckpt.checkpoint_manager(
+            self.workspace,
+            keep_period=max(cfg.training.eval_interval // cfg.training.checkpoint_interval, 1),
+        )
+        # auto-resume from this workspace; else warm-start from a path
+        state, start_step = ckpt.restore(manager, state)
+        if start_step == 0 and cfg.training.pretrained_checkpoint_path:
+            warm = ckpt.checkpoint_manager(cfg.training.pretrained_checkpoint_path)
+            state, warm_step = ckpt.restore(warm, state)
+            self.logger.info(
+                "warm-started from %s @ step %d",
+                cfg.training.pretrained_checkpoint_path, warm_step,
+            )
+        state = replicate_state(state, self.mesh)
+
+        lpips_params = load_lpips_params(cfg.training.lpips_weights_path)
+        train_step = make_parallel_train_step(cfg, self.model, tx, self.mesh)
+        eval_step = make_parallel_eval_step(cfg, self.model, self.mesh, lpips_params)
+
+        meters = {k: AverageMeter(k) for k in LOSS_KEYS}
+        timer = StepTimer(self.global_batch)
+        global_step = start_step
+        start_epoch = start_step // steps_per_epoch + 1
+        last_val: dict[str, float] = {}
+
+        if start_step:
+            self.logger.info("resumed from step %d (epoch %d)", start_step, start_epoch)
+        self.logger.info(
+            "training on mesh %s, global batch %d, %d steps/epoch",
+            dict(self.mesh.shape), self.global_batch, steps_per_epoch,
+        )
+
+        for epoch in range(start_epoch, cfg.training.epochs + 1):
+            for m in meters.values():
+                m.reset()
+            for step_in_epoch, batch_np in enumerate(train_ds.epoch(epoch), start=1):
+                if self.profile_steps and global_step == start_step + 5:
+                    jax.profiler.start_trace(os.path.join(self.workspace, "profile"))
+                batch = shard_batch(self.mesh, batch_np)
+                state, loss_dict = train_step(state, batch)
+                global_step += 1
+                timer.tick()
+                if self.profile_steps and global_step == start_step + 5 + self.profile_steps:
+                    jax.block_until_ready(loss_dict["loss"])
+                    jax.profiler.stop_trace()
+                    self.logger.info("profile trace written to %s/profile", self.workspace)
+
+                if step_in_epoch % cfg.training.log_interval == 0:
+                    host_losses = {k: float(loss_dict[k]) for k in LOSS_KEYS}
+                    for k, v in host_losses.items():
+                        meters[k].update(v, cfg.training.log_interval)
+                    lrs = learning_rates(cfg, steps_per_epoch, global_step)
+                    rate = timer.rate_and_reset()
+                    self.logger.info(
+                        "epoch [%03d] step [%d/%d] global_step=%d "
+                        "loss=%.4f rgb_tgt=%.4f ssim_tgt=%.4f disp_src=%.4f "
+                        "psnr=%.2f lr=%.6f imgs/sec=%.1f",
+                        epoch, step_in_epoch, steps_per_epoch, global_step,
+                        host_losses["loss"], host_losses["loss_rgb_tgt"],
+                        host_losses["loss_ssim_tgt"], host_losses["loss_disp_pt3dsrc"],
+                        host_losses["psnr_tgt"], lrs["backbone_lr"], rate,
+                    )
+                    self.writer.scalars(host_losses, global_step, prefix="train/")
+                    self.writer.scalar("train/imgs_per_sec", rate, global_step)
+                    self.writer.scalar("train/backbone_lr", lrs["backbone_lr"], global_step)
+
+                if global_step % cfg.training.checkpoint_interval == 0:
+                    ckpt.save(manager, jax.device_get(state), global_step)
+                    self.logger.info("checkpoint saved @ step %d", global_step)
+
+                if val_ds is not None and (
+                    global_step == 2000  # reference quirk: first eval at 2000
+                    or global_step % cfg.training.eval_interval == 0
+                ):
+                    last_val = self.evaluate(eval_step, state, val_ds, global_step)
+
+        ckpt.save(manager, jax.device_get(state), global_step)
+        ckpt.wait_until_finished(manager)
+        self.writer.flush()
+        return last_val
+
+    def evaluate(self, eval_step, state, val_ds: Any, global_step: int) -> dict[str, float]:
+        """Full-val-set metric pass (synthesis_task.py:496-527)."""
+        meters = {k: AverageMeter(k) for k in LOSS_KEYS}
+        key = jax.random.PRNGKey(self.cfg.training.seed + 17)
+        viz = None
+        for i, batch_np in enumerate(val_ds.epoch(0)):
+            batch = shard_batch(self.mesh, batch_np)
+            loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
+            for k in LOSS_KEYS:
+                meters[k].update(float(loss_dict[k]))
+        result = {k: m.avg for k, m in meters.items()}
+        self.logger.info(
+            "eval @ %d: " + " ".join(f"{k}=%.4f" for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")),
+            global_step, *[result[k] for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")],
+        )
+        self.writer.scalars(result, global_step, prefix="val/")
+        if viz is not None:
+            tgt = np.asarray(jax.device_get(viz["tgt_imgs_syn"]))[:4]
+            src = np.asarray(jax.device_get(viz["src_imgs_syn"]))[:4]
+            tgt_disp = normalize_disparity_for_vis(
+                np.asarray(jax.device_get(viz["tgt_disparity_syn"]))[:4]
+            )
+            self.writer.image_grid("val/tgt_syn", tgt, global_step)
+            self.writer.image_grid("val/src_syn", src, global_step)
+            self.writer.image_grid("val/tgt_disparity", tgt_disp, global_step)
+        self.writer.flush()
+        return result

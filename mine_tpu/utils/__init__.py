@@ -1,0 +1,9 @@
+"""Shared utilities (reference: utils.py)."""
+
+from mine_tpu.utils.logging import (
+    AverageMeter,
+    MetricWriter,
+    StepTimer,
+    make_logger,
+    normalize_disparity_for_vis,
+)

@@ -1,0 +1,147 @@
+"""Metrics, meters, and writers.
+
+Reference: utils.py:123-144 (AverageMeter), synthesis_task.py:529-607
+(TensorBoard scalars/image grids), train.py:177-197 (file+stdout logger).
+Additions the reference lacks (SURVEY.md §5.1): per-step wall-clock timing
+and imgs/sec in every log line, plus a machine-readable metrics.jsonl.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any
+
+import numpy as np
+
+
+class AverageMeter:
+    """Running mean of a scalar stream (reference utils.py:123-144)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.reset()
+
+    def reset(self) -> None:
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, value: float, n: int = 1) -> None:
+        self.sum += float(value) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    def __str__(self) -> str:
+        return f"{self.name} {self.avg:.4f} ({self.count})"
+
+
+def make_logger(workspace: str | None, name: str = "mine_tpu") -> logging.Logger:
+    """stdout (+ workspace file) logger, process-0 only emits by default
+    (reference gates on global_rank==0, train.py:177-197)."""
+    import jax
+
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    if jax.process_index() != 0:
+        logger.addHandler(logging.NullHandler())
+        return logger
+    fmt = logging.Formatter("[%(asctime)s %(levelname)s] %(message)s")
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if workspace:
+        os.makedirs(workspace, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(workspace, "train.log"))
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger
+
+
+class MetricWriter:
+    """Scalars + images to TensorBoard (tensorboardX) and metrics.jsonl.
+
+    The jsonl stream is the machine-readable twin of the reference's
+    TB-only logging; each line: {"step": n, "tag": ..., "value": ...}.
+    """
+
+    def __init__(self, workspace: str | None):
+        self._tb = None
+        self._jsonl = None
+        import jax
+
+        if workspace and jax.process_index() == 0:
+            os.makedirs(workspace, exist_ok=True)
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb = SummaryWriter(workspace)
+            except ImportError:
+                pass
+            self._jsonl = open(os.path.join(workspace, "metrics.jsonl"), "a")
+
+    def scalar(self, tag: str, value: Any, step: int) -> None:
+        value = float(value)
+        if self._tb:
+            self._tb.add_scalar(tag, value, step)
+        if self._jsonl:
+            self._jsonl.write(json.dumps({"step": step, "tag": tag, "value": value}) + "\n")
+
+    def scalars(self, values: dict[str, Any], step: int, prefix: str = "") -> None:
+        for tag, value in values.items():
+            self.scalar(prefix + tag, value, step)
+
+    def image_grid(self, tag: str, images: np.ndarray, step: int) -> None:
+        """(N, H, W, C) in [0,1] -> single row grid (reference
+        synthesis_task.py:537-568 uses torchvision make_grid)."""
+        if self._tb is None:
+            return
+        images = np.clip(np.asarray(images), 0.0, 1.0)
+        grid = np.concatenate(list(images), axis=1)  # (H, N*W, C)
+        self._tb.add_image(tag, grid, step, dataformats="HWC")
+
+    def flush(self) -> None:
+        if self._tb:
+            self._tb.flush()
+        if self._jsonl:
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        if self._tb:
+            self._tb.close()
+        if self._jsonl:
+            self._jsonl.close()
+
+
+def normalize_disparity_for_vis(disp: np.ndarray) -> np.ndarray:
+    """Min-max normalize per image for TB display (utils.py:6-17)."""
+    disp = np.asarray(disp)
+    lo = disp.min(axis=(1, 2, 3), keepdims=True)
+    hi = disp.max(axis=(1, 2, 3), keepdims=True)
+    return (disp - lo) / np.maximum(hi - lo, 1e-8)
+
+
+class StepTimer:
+    """imgs/sec over a rolling window (the §5.1 gap: the reference logs no
+    timing at all)."""
+
+    def __init__(self, batch_size: int):
+        self.batch_size = batch_size
+        self._t0 = time.perf_counter()
+        self._steps = 0
+
+    def tick(self) -> None:
+        self._steps += 1
+
+    def rate_and_reset(self) -> float:
+        now = time.perf_counter()
+        rate = self._steps * self.batch_size / max(now - self._t0, 1e-9)
+        self._t0 = now
+        self._steps = 0
+        return rate

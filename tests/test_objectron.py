@@ -1,0 +1,75 @@
+"""Objectron pipeline test over a synthetic on-disk scene (pickle metadata +
+mask-driven frame list, the reference's layout)."""
+
+import os
+import pickle
+
+import numpy as np
+from PIL import Image
+
+from mine_tpu.config import Config
+from mine_tpu.data.objectron import ADJUST, ObjectronDataset
+
+
+def _make_scene(root: str, scene: str, n_frames: int = 6, hw=(64, 64)):
+    h, w = hw
+    scene_dir = os.path.join(root, scene)
+    os.makedirs(os.path.join(scene_dir, "images_3"))
+    os.makedirs(os.path.join(scene_dir, "masks_3"))
+
+    rng = np.random.default_rng(0)
+    world_pts = rng.uniform(-0.2, 0.2, size=(64, 3)) + np.array([0, 0, 0.4])
+
+    poses, focals, centers = [], [], []
+    for i in range(n_frames):
+        # camera at small offsets looking down -z after the ADJUST flip
+        g_cam_world = np.eye(4)
+        g_cam_world[:3, 3] = [0.01 * i, 0.0, 0.0]
+        # reference stores c2w with G = inv(c2w @ ADJUST) => c2w = inv(G) @ inv(ADJUST)
+        c2w = np.linalg.inv(g_cam_world) @ np.linalg.inv(ADJUST)
+        poses.append(c2w)
+        focals.append([50.0, 50.0])
+        centers.append([w / 2, h / 2])
+
+        img = (rng.uniform(size=(h, w, 3)) * 255).astype(np.uint8)
+        # image is rotated 90° CCW at load; store pre-rotated (w, h) so the
+        # loaded frame lands at (h, w)
+        Image.fromarray(img).transpose(Image.ROTATE_270).save(
+            os.path.join(scene_dir, "images_3", f"{i}.png")
+        )
+        Image.new("L", (8, 8)).save(
+            os.path.join(scene_dir, "masks_3", f"seg_{i}.png")
+        )
+
+    with open(os.path.join(scene_dir, f"{scene}_metadata.pickle"), "wb") as fh:
+        pickle.dump({
+            "poses": np.stack(poses),
+            "focal": np.array(focals),
+            "c": np.array(centers),
+            "RT": np.eye(4),
+            "scale": 1.0,
+            "all_scene_points": world_pts,
+        }, fh)
+
+
+def test_objectron_dataset(tmp_path):
+    _make_scene(str(tmp_path), "chair_batch-1_0", n_frames=6)
+    cfg = Config().replace(**{
+        "data.name": "objectron",
+        "data.img_h": 64, "data.img_w": 64,
+        "data.training_set_path": str(tmp_path),
+        "data.visible_point_count": 16,
+    })
+    ds = ObjectronDataset(cfg, "train", global_batch=2)
+    assert len(ds) == 3
+    b = next(iter(ds.epoch(0)))
+    assert b["src_img"].shape == (2, 64, 64, 3)
+    assert b["pt3d_src"].shape == (2, 16, 3)
+    # pose chain: g_tgt_src between two cameras differing only in x offset
+    # has identity rotation
+    np.testing.assert_allclose(b["g_tgt_src"][0][:3, :3], np.eye(3), atol=1e-6)
+    # all points in front of the camera (z > 0 after the ADJUST flip)
+    assert np.all(b["pt3d_src"][..., 2] > 0)
+    # deterministic epochs
+    b2 = next(iter(ds.epoch(0)))
+    np.testing.assert_array_equal(b["src_img"], b2["src_img"])
