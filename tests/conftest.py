@@ -2,26 +2,20 @@
 
 This is the JAX idiom for testing SPMD code without hardware (the reference
 has no analog — its multi-GPU behavior was only ever validated on real jobs,
-SURVEY.md §4). Flags must be set before jax is imported anywhere.
+SURVEY.md §4). The forcing recipe (env flags + jax.config override, because
+the axon TPU PJRT plugin self-registers regardless of JAX_PLATFORMS) lives in
+__graft_entry__._force_virtual_cpu_mesh, shared with the driver's multichip
+dryrun; it must run before jax is imported anywhere.
 """
 
 import os
+import sys
 
-# Force (not setdefault): the environment may preset JAX_PLATFORMS to a real
-# accelerator platform, and the suite must run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from __graft_entry__ import _force_virtual_cpu_mesh
 
-# The env var alone is not enough: the axon TPU PJRT plugin in this image
-# registers itself regardless of JAX_PLATFORMS, and tests silently run on the
-# real chip (bf16 convs broke fp32 parity tests). The config override wins.
-jax.config.update("jax_platforms", "cpu")
+_force_virtual_cpu_mesh(8)
 
 import numpy as np
 import pytest
