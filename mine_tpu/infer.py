@@ -1,0 +1,63 @@
+"""Inference CLI: one image -> camera-path novel-view videos.
+
+    python -m mine_tpu.infer --checkpoint workspace/run --image photo.png \
+        --output_dir out/
+
+Reference entry point: visualizations/image_to_video.py:260-315 (loads the
+params.yaml paired with the checkpoint, fabricates a fov-90 camera, renders
+zoom-in + swing trajectories to video). `--checkpoint` is the training
+workspace directory (containing params.yaml and checkpoints/), matching this
+framework's orbax layout rather than a single .pth path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def load_image(path: str):
+    import numpy as np
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+def main(argv: list[str] | None = None) -> list[str]:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--checkpoint", required=True,
+        help="training workspace dir (params.yaml + checkpoints/)",
+    )
+    parser.add_argument("--image", required=True, help="input rgb image")
+    parser.add_argument("--output_dir", required=True)
+    parser.add_argument(
+        "--fov", type=float, default=90.0,
+        help="assumed horizontal field of view in degrees "
+        "(the reference hardcodes 90, image_to_video.py:195)",
+    )
+    parser.add_argument(
+        "--allow-random-init", action="store_true",
+        help="render with untrained weights when no checkpoint exists "
+        "(smoke runs only)",
+    )
+    args = parser.parse_args(argv)
+
+    from mine_tpu.inference import load_video_generator
+
+    generator = load_video_generator(
+        args.checkpoint,
+        load_image(args.image),
+        fov_deg=args.fov,
+        allow_random_init=args.allow_random_init,
+    )
+    basename = os.path.splitext(os.path.basename(args.image))[0]
+    written = generator.render_videos(args.output_dir, basename)
+    for path in written:
+        print(path)
+    return written
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Inference application: single image -> camera-path novel-view video.
+
+Reference: visualizations/image_to_video.py. The key property preserved from
+the reference (SURVEY.md §3.3): the expensive network pass runs ONCE; each
+frame costs only warp + composite. The TPU redesign goes further — the whole
+trajectory renders inside one jitted `lax.map`, so per-frame work is one
+compiled program with a single host transfer at the end, instead of the
+reference's per-frame eager dispatch loop.
+"""
+
+from mine_tpu.inference.trajectory import (
+    TRAJECTORY_PRESETS,
+    path_planning,
+    trajectory_preset,
+    camera_trajectories,
+)
+from mine_tpu.inference.video import (
+    VideoGenerator,
+    fov_intrinsics,
+    load_video_generator,
+    normalize_disparity,
+    render_many,
+    to_uint8,
+    write_video,
+)

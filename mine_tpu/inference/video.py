@@ -1,0 +1,261 @@
+"""Predict-once / render-many novel-view video generation.
+
+Reference: visualizations/image_to_video.py:92-257 (VideoGenerator). The
+network pass runs once per image; every frame after that is warp + composite
+only (the reference's key inference property, SURVEY.md §3.3). TPU redesign:
+instead of the reference's per-pose eager loop (:227-245), the whole pose
+trajectory renders inside ONE jitted `lax.map` — one compile, on-device frame
+loop, a single device->host transfer of the finished uint8-ready stack.
+
+The stale `render_pose` path of the reference (undefined `self.mpi_all_src`,
+image_to_video.py:206-219) is deliberately not replicated.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+from mine_tpu import ops
+from mine_tpu.config import Config
+from mine_tpu.inference.trajectory import camera_trajectories
+from mine_tpu.training.step import (
+    build_model,
+    make_disparity_list,
+    render_novel_view,
+)
+from mine_tpu.utils import normalize_disparity_for_vis
+
+
+def fov_intrinsics(height: int, width: int, fov_deg: float = 90.0) -> np.ndarray:
+    """Pinhole K for a given horizontal FoV, principal point at the center
+    (image_to_video.py:194-204: the single-image app fakes a fov-90 camera)."""
+    fov = math.radians(fov_deg)
+    fx = width * 0.5 / math.tan(fov * 0.5)
+    return np.array(
+        [[fx, 0.0, width * 0.5], [0.0, fx, height * 0.5], [0.0, 0.0, 1.0]],
+        dtype=np.float32,
+    )
+
+
+def prepare_image(image: np.ndarray, height: int, width: int) -> Array:
+    """HWC numpy image (uint8 or float in [0,1]) -> (1, height, width, 3)
+    float32, bilinear-resized (reference resizes with cv2 INTER_LINEAR,
+    image_to_video.py:104)."""
+    img = np.asarray(image)
+    if img.ndim != 3 or img.shape[-1] != 3:
+        raise ValueError(f"expected (H, W, 3) rgb image, got shape {img.shape}")
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    img = jnp.asarray(img, jnp.float32)[None]
+    if img.shape[1:3] != (height, width):
+        img = jax.image.resize(img, (1, height, width, 3), method="bilinear")
+    return jnp.clip(img, 0.0, 1.0)
+
+
+@partial(jax.jit, static_argnums=0)
+def render_many(
+    cfg: Config,
+    mpi_rgb: Array,
+    mpi_sigma: Array,
+    disparity: Array,
+    k: Array,
+    poses: Array,
+) -> tuple[Array, Array]:
+    """Render one source MPI into every pose of a trajectory.
+
+    poses: (N, 4, 4) G_tgt_src stack. Returns (rgb (N, H, W, 3),
+    disparity (N, H, W, 1)), all computed in one jitted on-device `lax.map`
+    (the reference's per-frame python loop, image_to_video.py:227-245).
+    Intrinsics are shared between source and target (single-image app); cfg is
+    a static (hashable) argument, so each (config, trajectory length) pair
+    compiles once and the MPI/pose arrays stay runtime inputs.
+    """
+    k_inv = ops.inverse_3x3(k)
+
+    def one_pose(g: Array) -> tuple[Array, Array]:
+        out = render_novel_view(
+            cfg, mpi_rgb, mpi_sigma, disparity, g[None], k_inv, k,
+            scale_factor=None,  # reference passes 1.0 (image_to_video.py:236)
+        )
+        return out["tgt_imgs_syn"][0], out["tgt_disparity_syn"][0]
+
+    return lax.map(one_pose, poses)
+
+
+def normalize_disparity(disparity: np.ndarray) -> np.ndarray:
+    """Per-frame min-max normalization to [0, 1] for visualization
+    (image_to_video.py:53-63; shares the TB-vis helper, utils/logging.py)."""
+    return np.clip(normalize_disparity_for_vis(disparity), 0.0, 1.0)
+
+
+def to_uint8(img: np.ndarray) -> np.ndarray:
+    """[0,1] float -> uint8 (image_to_video.py:66-75)."""
+    return np.clip(np.round(np.asarray(img) * 255.0), 0, 255).astype(np.uint8)
+
+
+def colorize_heat(gray_u8: np.ndarray) -> np.ndarray:
+    """(..., H, W) uint8 -> (..., H, W, 3) rgb heat colormap (the reference's
+    cv2.COLORMAP_HOT disparity vis, image_to_video.py:73-74); grayscale
+    fallback when cv2 is unavailable."""
+    try:
+        import cv2
+    except ImportError:
+        return np.repeat(gray_u8[..., None], 3, axis=-1)
+    flat = gray_u8.reshape(-1, *gray_u8.shape[-2:])
+    out = np.stack(
+        [
+            cv2.cvtColor(cv2.applyColorMap(f, cv2.COLORMAP_HOT), cv2.COLOR_BGR2RGB)
+            for f in flat
+        ]
+    )
+    return out.reshape(*gray_u8.shape, 3)
+
+
+def write_video(frames: np.ndarray, path: str, fps: int = 30) -> str:
+    """Write (N, H, W, 3) uint8 rgb frames to mp4 (cv2 backend); falls back to
+    a PNG sequence directory when no mp4 encoder exists (this image has no
+    ffmpeg; the reference uses moviepy, image_to_video.py:248-257).
+
+    Returns the path actually written (the .mp4, or the PNG directory).
+    """
+    frames = np.asarray(frames)
+    assert frames.dtype == np.uint8 and frames.ndim == 4 and frames.shape[-1] == 3
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    try:
+        import cv2
+
+        h, w = frames.shape[1:3]
+        writer = cv2.VideoWriter(
+            path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h)
+        )
+        if writer.isOpened():
+            for frame in frames:
+                writer.write(frame[..., ::-1])  # rgb -> bgr
+            writer.release()
+            return path
+    except ImportError:
+        pass
+    import imageio.v3 as iio
+
+    frame_dir = os.path.splitext(path)[0]
+    os.makedirs(frame_dir, exist_ok=True)
+    for i, frame in enumerate(frames):
+        iio.imwrite(os.path.join(frame_dir, f"{i:04d}.png"), frame)
+    return frame_dir
+
+
+@partial(jax.jit, static_argnums=0)
+def predict_blended_mpi(
+    cfg: Config, variables: Any, img: Array, disparity: Array, k: Array
+) -> tuple[Array, Array]:
+    """One network pass + src RGB blending (image_to_video.py:136-156):
+    plane RGB is replaced by the real source pixels wherever the source view
+    sees them; network RGB survives only where occluded. Module-level jit with
+    cfg static, so repeated VideoGenerators with one config compile once."""
+    model = build_model(cfg)
+    mpi = model.apply(variables, img, disparity, False)[0]
+    mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
+    grid = ops.homogeneous_pixel_grid(img.shape[1], img.shape[2])
+    xyz_src = ops.get_src_xyz_from_plane_disparity(
+        grid, disparity, ops.inverse_3x3(k)
+    )
+    _, _, blend_weights, _ = ops.render(
+        mpi_rgb, mpi_sigma, xyz_src,
+        use_alpha=cfg.mpi.use_alpha,
+        is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
+    )
+    mpi_rgb = blend_weights * img[:, None] + (1.0 - blend_weights) * mpi_rgb
+    return mpi_rgb, mpi_sigma
+
+
+class VideoGenerator:
+    """Predict an MPI from one image, then render camera-path videos
+    (image_to_video.py:92-257)."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        batch_stats: Any,
+        image: np.ndarray,
+        fov_deg: float = 90.0,
+    ):
+        self.cfg = cfg
+        h, w = cfg.data.img_h, cfg.data.img_w
+        self.img = prepare_image(image, h, w)
+        self.k = jnp.asarray(fov_intrinsics(h, w, fov_deg))[None]
+
+        # Inference planes are deterministic: the fix_disparity branch of the
+        # shared sampler (linspace, or the explicit bin list when configured
+        # — synthesis_task.py:36-45).
+        fixed_cfg = cfg.replace(**{"mpi.fix_disparity": True})
+        self.disparity = make_disparity_list(fixed_cfg, jax.random.PRNGKey(0), 1)
+
+        variables = {"params": params, "batch_stats": batch_stats}
+        self.mpi_rgb, self.mpi_sigma = predict_blended_mpi(
+            cfg, variables, self.img, self.disparity, self.k
+        )
+
+    def render_poses(self, poses: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Render (N, 4, 4) poses -> (rgb (N,H,W,3) float [0,1],
+        disparity (N,H,W,1) float, unnormalized)."""
+        rgb, disp = render_many(
+            self.cfg, self.mpi_rgb, self.mpi_sigma, self.disparity,
+            self.k, jnp.asarray(poses),
+        )
+        return np.asarray(jax.device_get(rgb)), np.asarray(jax.device_get(disp))
+
+    def render_videos(self, output_dir: str, basename: str) -> list[str]:
+        """Render every preset trajectory for this dataset and write
+        <basename>_<traj>_{rgb,disp} videos (image_to_video.py:221-257).
+        Returns the written paths."""
+        trajectories, fps = camera_trajectories(self.cfg.data.name)
+        written = []
+        for name, poses in trajectories:
+            rgb, disp = self.render_poses(poses)
+            rgb_u8 = to_uint8(rgb)
+            disp_u8 = colorize_heat(to_uint8(normalize_disparity(disp))[..., 0])
+            written.append(write_video(
+                rgb_u8, os.path.join(output_dir, f"{basename}_{name}_rgb.mp4"), fps
+            ))
+            written.append(write_video(
+                disp_u8, os.path.join(output_dir, f"{basename}_{name}_disp.mp4"), fps
+            ))
+        return written
+
+
+def load_video_generator(
+    workspace: str,
+    image: np.ndarray,
+    fov_deg: float = 90.0,
+    allow_random_init: bool = False,
+) -> VideoGenerator:
+    """Build a VideoGenerator from a training workspace: config from the
+    paired params.yaml, weights from the newest orbax checkpoint
+    (image_to_video.py:273-285; checkpoint+config travel as a pair)."""
+    import jax.random as jrandom
+
+    from mine_tpu.training import checkpoint as ckpt
+    from mine_tpu.training.optimizer import make_optimizer
+    from mine_tpu.training.step import init_state
+
+    cfg = ckpt.load_paired_config(workspace)
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=1)
+    template = init_state(cfg, model, tx, jrandom.PRNGKey(0))
+    manager = ckpt.checkpoint_manager(workspace)
+    state, step = ckpt.restore(manager, template)
+    if step == 0 and not allow_random_init:
+        raise FileNotFoundError(
+            f"no checkpoint found under {workspace}/checkpoints "
+            "(pass allow_random_init=True for an untrained smoke run)"
+        )
+    return VideoGenerator(cfg, state.params, state.batch_stats, image, fov_deg)
