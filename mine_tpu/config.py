@@ -35,14 +35,14 @@ class DataConfig:
     img_w: int = 512
     img_pre_downsample_ratio: float = 7.875
     per_gpu_batch_size: int = 4  # per-device batch (reference key name kept)
+    # targets sampled per source view; each (src, tgt) pair fills one batch
+    # slot, so per_gpu_batch_size must divide by it (the reference defines
+    # this key but asserts L==1 at runtime, synthesis_task.py:203-204)
     num_tgt_views: int = 1
-    training_set_path: str = ""
-    val_set_path: str = ""
+    training_set_path: str = ""  # val reuses it with the _val folder suffix
     visible_point_count: int = 256
+    # host-side loader prefetch depth; 0 = fully synchronous
     num_workers: int = 4
-    # dtu-only knobs (params_default.yaml:14-15)
-    rotation_pi_ratio: int = 3
-    is_exclude_views: bool = True
 
 
 @dataclass(frozen=True)
@@ -57,8 +57,6 @@ class LRConfig:
 @dataclass(frozen=True)
 class ModelConfig:
     num_layers: int = 50  # hardcoded in the reference (synthesis_task.py:69)
-    backbone_normalization: bool = True
-    decoder_normalization: bool = True
     pos_encoding_multires: int = 10
     imagenet_pretrained: bool = True
     # path to a converted ResNet .npz (tools/convert_resnet.py); empty =>
@@ -99,9 +97,7 @@ class LossConfig:
 class TrainingConfig:
     epochs: int = 15
     eval_interval: int = 10000
-    fine_tune: bool = False
     pretrained_checkpoint_path: str = ""
-    sample_interval: int = 30
     src_rgb_blending: bool = True
     use_multi_scale: bool = True
     seed: int = 0
@@ -120,11 +116,6 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
-class TestingConfig:
-    frames_apart: str = "random"
-
-
-@dataclass(frozen=True)
 class Config:
     data: DataConfig = field(default_factory=DataConfig)
     lr: LRConfig = field(default_factory=LRConfig)
@@ -133,7 +124,6 @@ class Config:
     loss: LossConfig = field(default_factory=LossConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
-    testing: TestingConfig = field(default_factory=TestingConfig)
 
     def replace(self, **dot_key_values: Any) -> "Config":
         """Functional update by dot-keys: cfg.replace(**{"mpi.num_bins_coarse": 8})."""
@@ -146,6 +136,21 @@ class Config:
 
 
 _GROUPS = {f.name: f for f in dataclasses.fields(Config)}
+
+# Keys that once existed (reference parity rot, deleted because nothing reads
+# them — VERDICT r2) but may still appear in archived params.yaml files next
+# to old checkpoints. Loading tolerates exactly these, with a warning; any
+# other unknown key is still an error.
+_RETIRED_KEYS = frozenset({
+    "data.val_set_path",
+    "data.rotation_pi_ratio",
+    "data.is_exclude_views",
+    "model.backbone_normalization",
+    "model.decoder_normalization",
+    "training.fine_tune",
+    "training.sample_interval",
+    "testing.frames_apart",
+})
 
 
 def _coerce(value: Any, target_type: Any, key: str) -> Any:
@@ -237,6 +242,13 @@ def load_config(
         layers.append(overrides)
     for layer in layers:
         for key, value in layer.items():
+            if key in _RETIRED_KEYS:
+                import logging
+
+                logging.getLogger("mine_tpu").warning(
+                    "ignoring retired config key %r (archived params.yaml?)", key
+                )
+                continue
             if key not in flat:
                 raise KeyError(f"unknown config key: {key!r}")
             flat[key] = value

@@ -134,6 +134,15 @@ class LLFFDataset:
         is_val = split == "val"
         self.is_val = is_val
         self.rng_seed = cfg.training.seed + (991 if is_val else 0)
+        # num_tgt_views targets per source, each filling one batch slot (the
+        # reference's supervision_count, which it caps at 1 in practice —
+        # synthesis_task.py:203-204; here any k dividing the batch works)
+        self.num_tgt_views = cfg.data.num_tgt_views
+        if self.num_tgt_views < 1 or global_batch % self.num_tgt_views:
+            raise ValueError(
+                f"data.num_tgt_views={self.num_tgt_views} must be >= 1 and "
+                f"divide the global batch {global_batch}"
+            )
 
         ratio = cfg.data.img_pre_downsample_ratio
         folder = "images" if ratio is None or ratio <= 1 else f"images_{ratio}"
@@ -166,48 +175,57 @@ class LLFFDataset:
         for i, im in enumerate(self.images):
             self.scene_indices.setdefault(im.scene, []).append(i)
         for scene, idxs in self.scene_indices.items():
-            if len(idxs) < 2:
-                raise ValueError(f"scene {scene} has {len(idxs)} image(s); need >= 2")
+            if len(idxs) < self.num_tgt_views + 1:
+                raise ValueError(
+                    f"scene {scene} has {len(idxs)} image(s); need >= "
+                    f"{self.num_tgt_views + 1} for {self.num_tgt_views} target(s)"
+                )
 
     def __len__(self) -> int:
-        return max(len(self.images) // self.global_batch, 1)
+        return max(len(self.images) // (self.global_batch // self.num_tgt_views), 1)
 
-    def _example(self, src_idx: int, rng: np.random.Generator) -> dict[str, np.ndarray]:
+    def _examples(self, src_idx: int, rng: np.random.Generator) -> list[dict[str, np.ndarray]]:
+        """num_tgt_views (src, tgt) pairs for one source view."""
         src = self.images[src_idx]
         scene_idxs = [i for i in self.scene_indices[src.scene] if i != src_idx]
+        k = self.num_tgt_views
         if self.is_val:
-            # deterministic neighbor (nerf_dataset.py:205-208)
-            tgt_idx = scene_idxs[(src_idx + 1) % len(scene_idxs) - 1]
+            # deterministic neighbor(s) (nerf_dataset.py:205-208)
+            base = (src_idx + 1) % len(scene_idxs) - 1
+            tgt_idxs = [scene_idxs[(base + j) % len(scene_idxs)] for j in range(k)]
         else:
-            tgt_idx = int(rng.choice(scene_idxs))
-        tgt = self.images[tgt_idx]
+            tgt_idxs = [int(i) for i in rng.choice(scene_idxs, size=k, replace=False)]
 
         n_pt = self.cfg.data.visible_point_count
-        src_pts = src.pts_cam[rng.choice(len(src.pts_cam), n_pt, replace=False)]
-        tgt_pts = tgt.pts_cam[rng.choice(len(tgt.pts_cam), n_pt, replace=False)]
-
-        # G_tgt_src maps src-camera coords to tgt-camera coords
-        # (reference builds G_src_tgt then inverts at set_data,
-        # nerf_dataset.py:219-221 + synthesis_task.py:211)
-        g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
-        return {
-            "src_img": src.img,
-            "tgt_img": tgt.img,
-            "k_src": src.k,
-            "k_tgt": tgt.k,
-            "g_tgt_src": g_tgt_src.astype(np.float32),
-            "pt3d_src": src_pts,
-            "pt3d_tgt": tgt_pts,
-        }
+        out = []
+        for tgt_idx in tgt_idxs:
+            tgt = self.images[tgt_idx]
+            src_pts = src.pts_cam[rng.choice(len(src.pts_cam), n_pt, replace=False)]
+            tgt_pts = tgt.pts_cam[rng.choice(len(tgt.pts_cam), n_pt, replace=False)]
+            # G_tgt_src maps src-camera coords to tgt-camera coords
+            # (reference builds G_src_tgt then inverts at set_data,
+            # nerf_dataset.py:219-221 + synthesis_task.py:211)
+            g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
+            out.append({
+                "src_img": src.img,
+                "tgt_img": tgt.img,
+                "k_src": src.k,
+                "k_tgt": tgt.k,
+                "g_tgt_src": g_tgt_src.astype(np.float32),
+                "pt3d_src": src_pts,
+                "pt3d_tgt": tgt_pts,
+            })
+        return out
 
     def epoch(self, epoch: int):
         rng = np.random.default_rng((self.rng_seed, epoch))
         order = rng.permutation(len(self.images))
-        for start in range(0, len(self) * self.global_batch, self.global_batch):
-            idxs = order[start : start + self.global_batch]
-            if len(idxs) < self.global_batch:  # drop_last
+        n_src = self.global_batch // self.num_tgt_views
+        for start in range(0, len(self) * n_src, n_src):
+            idxs = order[start : start + n_src]
+            if len(idxs) < n_src:  # drop_last
                 break
-            examples = [self._example(int(i), rng) for i in idxs]
+            examples = [e for i in idxs for e in self._examples(int(i), rng)]
             yield {
                 k: np.stack([e[k] for e in examples]) for k in examples[0]
             }

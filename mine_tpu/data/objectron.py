@@ -23,6 +23,7 @@ reference leaves K untouched, same geometry error as its NOCS crop).
 
 from __future__ import annotations
 
+import bisect
 import glob
 import os
 import pickle
@@ -120,6 +121,13 @@ class ObjectronDataset:
         self.is_val = split == "val"
         self.global_batch = global_batch
         self.rng_seed = cfg.training.seed + (991 if self.is_val else 0)
+        # see LLFFDataset: k (src, tgt) pairs per source, k slots of the batch
+        self.num_tgt_views = cfg.data.num_tgt_views
+        if self.num_tgt_views < 1 or global_batch % self.num_tgt_views:
+            raise ValueError(
+                f"data.num_tgt_views={self.num_tgt_views} must be >= 1 and "
+                f"divide the global batch {global_batch}"
+            )
 
         root = cfg.data.training_set_path
         self.frames: list[ObjectronFrame] = []
@@ -135,43 +143,63 @@ class ObjectronDataset:
         self.scene_indices: dict[str, list[int]] = {}
         for i, fr in enumerate(self.frames):
             self.scene_indices.setdefault(fr.scene, []).append(i)
+        # fail at construction, not hours into an epoch: every frame must
+        # have enough in-window neighbors for num_tgt_views distinct targets
+        # (bisect count — idxs are sorted — keeps this O(F log F) per scene)
+        for scene, idxs in self.scene_indices.items():
+            for i in idxs:
+                lo = bisect.bisect_left(idxs, i - FRAME_WINDOW)
+                hi = bisect.bisect_right(idxs, i + FRAME_WINDOW)
+                n = hi - lo - 1  # excluding the frame itself
+                if n < self.num_tgt_views:
+                    raise ValueError(
+                        f"frame {i} of scene {scene} has {n} neighbors within "
+                        f"±{FRAME_WINDOW}; need >= num_tgt_views="
+                        f"{self.num_tgt_views}"
+                    )
 
     def __len__(self) -> int:
-        return max(len(self.frames) // self.global_batch, 1)
+        return max(len(self.frames) // (self.global_batch // self.num_tgt_views), 1)
 
-    def _example(self, src_idx: int, rng: np.random.Generator) -> dict[str, np.ndarray]:
+    def _examples(self, src_idx: int, rng: np.random.Generator) -> list[dict[str, np.ndarray]]:
         src = self.frames[src_idx]
         # ±FRAME_WINDOW same-scene candidates (objectron.py:176-186)
         neighbors = [
             i for i in self.scene_indices[src.scene]
             if i != src_idx and abs(i - src_idx) <= FRAME_WINDOW
         ]
+        k = self.num_tgt_views  # >= k neighbors guaranteed by __init__
         if self.is_val:
-            tgt_idx = neighbors[(src_idx + 1) % len(neighbors) - 1]
+            base = (src_idx + 1) % len(neighbors) - 1
+            tgt_idxs = [neighbors[(base + j) % len(neighbors)] for j in range(k)]
         else:
-            tgt_idx = int(rng.choice(neighbors))
-        tgt = self.frames[tgt_idx]
+            tgt_idxs = [int(i) for i in rng.choice(neighbors, size=k, replace=False)]
 
         n_pt = self.cfg.data.visible_point_count
-        src_sel = rng.choice(len(src.pts_cam), n_pt, replace=len(src.pts_cam) < n_pt)
-        tgt_sel = rng.choice(len(tgt.pts_cam), n_pt, replace=len(tgt.pts_cam) < n_pt)
-        g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
-        return {
-            "src_img": src.img,
-            "tgt_img": tgt.img,
-            "k_src": src.k,
-            "k_tgt": tgt.k,
-            "g_tgt_src": g_tgt_src.astype(np.float32),
-            "pt3d_src": src.pts_cam[src_sel],
-            "pt3d_tgt": tgt.pts_cam[tgt_sel],
-        }
+        out = []
+        for tgt_idx in tgt_idxs:
+            tgt = self.frames[tgt_idx]
+            src_sel = rng.choice(len(src.pts_cam), n_pt, replace=len(src.pts_cam) < n_pt)
+            tgt_sel = rng.choice(len(tgt.pts_cam), n_pt, replace=len(tgt.pts_cam) < n_pt)
+            g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
+            out.append({
+                "src_img": src.img,
+                "tgt_img": tgt.img,
+                "k_src": src.k,
+                "k_tgt": tgt.k,
+                "g_tgt_src": g_tgt_src.astype(np.float32),
+                "pt3d_src": src.pts_cam[src_sel],
+                "pt3d_tgt": tgt.pts_cam[tgt_sel],
+            })
+        return out
 
     def epoch(self, epoch: int):
         rng = np.random.default_rng((self.rng_seed, epoch))
         order = rng.permutation(len(self.frames))
-        for start in range(0, len(self) * self.global_batch, self.global_batch):
-            idxs = order[start : start + self.global_batch]
-            if len(idxs) < self.global_batch:
+        n_src = self.global_batch // self.num_tgt_views
+        for start in range(0, len(self) * n_src, n_src):
+            idxs = order[start : start + n_src]
+            if len(idxs) < n_src:
                 break
-            examples = [self._example(int(i), rng) for i in idxs]
+            examples = [e for i in idxs for e in self._examples(int(i), rng)]
             yield {k: np.stack([e[k] for e in examples]) for k in examples[0]}

@@ -1,0 +1,77 @@
+"""Host-side input-pipeline overlap.
+
+Reference gap (SURVEY.md §7.4.7): the reference builds every batch
+synchronously inside the step loop (single-threaded PIL + numpy,
+nerf_dataset.py:199-236) — at TPU step rates the host starves the device.
+Here a daemon thread keeps up to `data.num_workers` batches ready ahead of
+the consumer, and the device transfer (shard_batch / device_put) runs inside
+that thread too, so H2D copies overlap the previous step's compute
+(double-buffering at depth >= 1). depth <= 0 degrades to the reference's
+synchronous behavior.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+
+class _End:
+    pass
+
+
+class _Raised:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch(
+    iterable: Iterable[Any],
+    depth: int,
+    transfer: Callable[[Any], Any] | None = None,
+) -> Iterator[Any]:
+    """Yield items of `iterable`, produced (and `transfer`ed) up to `depth`
+    items ahead on a background thread. Exceptions from the producer re-raise
+    at the consumer's next pull. If the consumer abandons the generator early,
+    the producer thread is unblocked and exits (daemon either way)."""
+    if depth <= 0:
+        for item in iterable:
+            yield transfer(item) if transfer is not None else item
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put_or_stop(item: Any) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker() -> None:
+        try:
+            for item in iterable:
+                out = transfer(item) if transfer is not None else item
+                if not put_or_stop(out):
+                    return
+            put_or_stop(_End())
+        except BaseException as exc:  # noqa: BLE001 - relayed to the consumer
+            put_or_stop(_Raised(exc))
+
+    thread = threading.Thread(target=worker, daemon=True, name="batch-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, _End):
+                return
+            if isinstance(item, _Raised):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()

@@ -250,7 +250,9 @@ def load_video_generator(
     cfg = ckpt.load_paired_config(workspace)
     model = build_model(cfg)
     tx = make_optimizer(cfg, steps_per_epoch=1)
-    template = init_state(cfg, model, tx, jrandom.PRNGKey(0))
+    # template only — the restore overwrites it, so don't require the
+    # training-time pretrained .npz to exist on this host
+    template = init_state(cfg, model, tx, jrandom.PRNGKey(0), load_pretrained=False)
     manager = ckpt.checkpoint_manager(workspace)
     state, step = ckpt.restore(manager, template)
     if step == 0 and not allow_random_init:

@@ -10,3 +10,7 @@ from mine_tpu.models.embedder import embed_dim, positional_encode
 from mine_tpu.models.encoder import ResNetEncoder, encoder_channels
 from mine_tpu.models.decoder import MPIDecoder, NUM_CH_DEC, nearest_up2
 from mine_tpu.models.mpi import MPINetwork, predict_mpi_coarse_to_fine
+from mine_tpu.models.pretrained import (
+    apply_pretrained_backbone,
+    load_backbone_npz,
+)

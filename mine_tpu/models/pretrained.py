@@ -1,0 +1,75 @@
+"""Runtime loader for converted pretrained backbone weights.
+
+The reference downloads torchvision ImageNet weights at model construction,
+on rank 0 only, with no broadcast (resnet_encoder.py:56-60 — a SURVEY.md §2.4
+deadlock hazard). Here pretrained weights are an offline artifact: run
+tools/convert_resnet.py once (anywhere torch + the checkpoint live) to get an
+.npz, point `model.pretrained_backbone_path` at it, and every process loads
+identical weights before compilation — no egress, no rank asymmetry, no torch
+at runtime.
+
+The .npz key format is `<collection>/backbone/<module path>/<param>` (e.g.
+`params/backbone/Bottleneck_3/Conv_1/kernel`,
+`batch_stats/backbone/SyncBatchNorm_0/BatchNorm_0/mean`), exactly the flax
+variable tree paths of mine_tpu.models.encoder.ResNetEncoder.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import traverse_util
+
+_COLLECTIONS = ("params", "batch_stats")
+
+
+def load_backbone_npz(path: str) -> dict[str, dict[str, np.ndarray]]:
+    """Read a converted .npz into {collection: {flat/backbone/path: array}}."""
+    raw = np.load(path)
+    out: dict[str, dict[str, np.ndarray]] = {c: {} for c in _COLLECTIONS}
+    for key in raw.files:
+        coll, rest = key.split("/", 1)
+        if coll not in _COLLECTIONS or not rest.startswith("backbone/"):
+            raise ValueError(f"{path}: unexpected key {key!r}")
+        out[coll][rest[len("backbone/"):]] = raw[key]
+    return out
+
+
+def apply_pretrained_backbone(variables: dict[str, Any], path: str) -> dict[str, Any]:
+    """Return `variables` with the backbone subtree replaced by the converted
+    weights at `path`. Strict: the .npz must cover the backbone's parameter
+    tree exactly (no missing, no extra, no shape drift) — the reference's
+    tolerant strict=False load (utils.py:64-67) silently skips mismatches,
+    which is how weight-layout bugs hide.
+    """
+    loaded = load_backbone_npz(path)
+    out = dict(variables)
+    for coll in _COLLECTIONS:
+        tree = variables.get(coll)
+        if tree is None or "backbone" not in tree:
+            raise ValueError(f"model variables have no {coll}/backbone subtree")
+        flat = traverse_util.flatten_dict(tree["backbone"], sep="/")
+        src = loaded[coll]
+        missing = sorted(set(flat) - set(src))
+        extra = sorted(set(src) - set(flat))
+        if missing or extra:
+            raise ValueError(
+                f"{path} does not match the backbone {coll} tree "
+                f"(missing {len(missing)}: {missing[:4]}...; "
+                f"extra {len(extra)}: {extra[:4]}...) — was it converted with "
+                "the right --num-layers?"
+            )
+        bad_shapes = [
+            (k, src[k].shape, tuple(flat[k].shape))
+            for k in flat
+            if tuple(src[k].shape) != tuple(flat[k].shape)
+        ]
+        if bad_shapes:
+            raise ValueError(f"{path}: shape mismatches {bad_shapes[:4]}...")
+        new_flat = {k: jnp.asarray(src[k], flat[k].dtype) for k in flat}
+        new_tree = dict(tree)
+        new_tree["backbone"] = traverse_util.unflatten_dict(new_flat, sep="/")
+        out[coll] = new_tree
+    return out

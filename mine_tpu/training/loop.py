@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from mine_tpu.config import Config
+from mine_tpu.data import prefetch
 from mine_tpu.losses import load_lpips_params
 from mine_tpu.parallel import (
     DATA_AXIS,
@@ -63,21 +64,50 @@ class Trainer:
             os.makedirs(workspace, exist_ok=True)
             ckpt.save_paired_config(cfg, workspace)
 
+    def _staged_batches(self, epoch_iter: Iterable[dict]) -> Iterable[dict]:
+        """Two-stage pipeline overlap (SURVEY.md §7.4.7; the reference builds
+        every batch synchronously in the step loop, nerf_dataset.py:199-236):
+        host batches are produced up to data.num_workers ahead, but at most 2
+        of them are device-staged (shard_batch) at a time — double-buffered
+        H2D without pinning num_workers full batches in HBM."""
+        depth = self.cfg.data.num_workers
+        host = prefetch(epoch_iter, max(depth - 2, 0))
+        return prefetch(
+            host, min(depth, 2), transfer=lambda b: shard_batch(self.mesh, b)
+        )
+
     def fit(self, train_ds: Any, val_ds: Any | None = None) -> dict[str, float]:
         cfg = self.cfg
         steps_per_epoch = len(train_ds)
         tx = make_optimizer(cfg, steps_per_epoch)
-        state = init_state(cfg, self.model, tx, jax.random.PRNGKey(cfg.training.seed))
-
         manager = ckpt.checkpoint_manager(
             self.workspace,
             keep_period=max(cfg.training.eval_interval // cfg.training.checkpoint_interval, 1),
+        )
+        # pretrained backbone weights only matter on a fresh start; on resume
+        # or warm start the restore overwrites them, and the .npz need not
+        # exist on this host
+        resuming = (
+            manager.latest_step() is not None
+            or bool(cfg.training.pretrained_checkpoint_path)
+        )
+        state = init_state(
+            cfg, self.model, tx, jax.random.PRNGKey(cfg.training.seed),
+            load_pretrained=not resuming,
         )
         # auto-resume from this workspace; else warm-start from a path
         state, start_step = ckpt.restore(manager, state)
         if start_step == 0 and cfg.training.pretrained_checkpoint_path:
             warm = ckpt.checkpoint_manager(cfg.training.pretrained_checkpoint_path)
             state, warm_step = ckpt.restore(warm, state)
+            if warm_step == 0:
+                # restore() returns the template silently; a typo'd warm-start
+                # path must not degrade into training from random init
+                raise FileNotFoundError(
+                    "training.pretrained_checkpoint_path="
+                    f"{cfg.training.pretrained_checkpoint_path!r} contains no "
+                    "checkpoint"
+                )
             self.logger.info(
                 "warm-started from %s @ step %d",
                 cfg.training.pretrained_checkpoint_path, warm_step,
@@ -104,10 +134,10 @@ class Trainer:
         for epoch in range(start_epoch, cfg.training.epochs + 1):
             for m in meters.values():
                 m.reset()
-            for step_in_epoch, batch_np in enumerate(train_ds.epoch(epoch), start=1):
+            batches = self._staged_batches(train_ds.epoch(epoch))
+            for step_in_epoch, batch in enumerate(batches, start=1):
                 if self.profile_steps and global_step == start_step + 5:
                     jax.profiler.start_trace(os.path.join(self.workspace, "profile"))
-                batch = shard_batch(self.mesh, batch_np)
                 state, loss_dict = train_step(state, batch)
                 global_step += 1
                 timer.tick()
@@ -155,8 +185,7 @@ class Trainer:
         meters = {k: AverageMeter(k) for k in LOSS_KEYS}
         key = jax.random.PRNGKey(self.cfg.training.seed + 17)
         viz = None
-        for i, batch_np in enumerate(val_ds.epoch(0)):
-            batch = shard_batch(self.mesh, batch_np)
+        for i, batch in enumerate(self._staged_batches(val_ds.epoch(0))):
             loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
             for k in LOSS_KEYS:
                 meters[k].update(float(loss_dict[k]))

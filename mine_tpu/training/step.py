@@ -17,8 +17,10 @@ staging at synthesis_task.py:172-212):
   pt3d_src, pt3d_tgt: (B, N, 3) sparse COLMAP points in each camera frame
 
 The reference's L==1 single-target assert (synthesis_task.py:203-204) is a
-memory ceiling, not a design choice; the batch carries one target view for
-parity, and more targets = bigger B at the loader level.
+memory ceiling, not a design choice; here each batch slot is one (src, tgt)
+pair and `data.num_tgt_views` targets per source are flattened into the batch
+by the loaders (data/llff.py, data/objectron.py), so multi-target supervision
+is a batch-size knob rather than a fifth tensor axis.
 """
 
 from __future__ import annotations
@@ -449,14 +451,42 @@ def init_state(
     model: MPINetwork,
     tx: optax.GradientTransformation,
     rng: Array,
+    load_pretrained: bool = True,
 ) -> TrainState:
-    """Initialize params/batch_stats/optimizer into a TrainState."""
+    """Initialize params/batch_stats/optimizer into a TrainState.
+
+    With `model.imagenet_pretrained` and a `model.pretrained_backbone_path`
+    (an .npz from tools/convert_resnet.py), the encoder starts from converted
+    ImageNet weights — the reference's torchvision download
+    (resnet_encoder.py:56-60), minus the egress and the rank-0-only
+    asymmetry: every process loads the identical artifact. Pass
+    load_pretrained=False when the state is only a template for a checkpoint
+    restore (resume, inference): the restore overwrites everything, and the
+    .npz need not exist on that host.
+    """
     key_init, key_state = jax.random.split(rng)
     dummy_img = jnp.zeros((1, cfg.data.img_h, cfg.data.img_w, 3), jnp.float32)
     dummy_disp = jnp.linspace(
         cfg.mpi.disparity_start, cfg.mpi.disparity_end, cfg.mpi.num_bins_coarse
     )[None, :]
     variables = model.init(key_init, dummy_img, dummy_disp, True)
+    if cfg.model.imagenet_pretrained and load_pretrained:
+        if cfg.model.pretrained_backbone_path:
+            from mine_tpu.models import apply_pretrained_backbone
+
+            variables = apply_pretrained_backbone(
+                variables, cfg.model.pretrained_backbone_path
+            )
+        else:
+            import logging
+
+            logging.getLogger("mine_tpu").warning(
+                "model.imagenet_pretrained is set but "
+                "model.pretrained_backbone_path is empty — the backbone "
+                "starts RANDOM. Convert weights offline with "
+                "tools/convert_resnet.py (no-egress substitute for the "
+                "reference's torchvision download)."
+            )
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     opt_state = tx.init(params)

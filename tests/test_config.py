@@ -38,7 +38,7 @@ def test_layering_order():
     cfg = _cfg("default", "llff")
     assert cfg.data.per_gpu_batch_size == 2  # llff overrides default's 4
     assert cfg.lr.decay_steps == (60, 90, 120)
-    assert cfg.training.sample_interval == 30  # untouched default survives
+    assert cfg.training.checkpoint_interval == 5000  # untouched default survives
 
 
 def test_json_overrides_win():
@@ -50,6 +50,17 @@ def test_json_overrides_win():
 def test_unknown_key_rejected():
     with pytest.raises(KeyError, match="unknown config key"):
         _cfg("default", overrides={"mpi.render_tgt_rgb_depth": True})
+
+
+def test_retired_keys_tolerated():
+    """Archived params.yaml files written before the dead-key pruning must
+    still load (old checkpoints pair with old configs)."""
+    cfg = _cfg("default", overrides={
+        "training.fine_tune": True,      # retired: ignored with a warning
+        "data.val_set_path": "/old",     # retired: ignored
+        "mpi.num_bins_coarse": 8,        # live: applied
+    })
+    assert cfg.mpi.num_bins_coarse == 8
 
 
 def test_csv_decay_steps_accepted():

@@ -136,6 +136,26 @@ def test_llff_epoch_determinism_and_shuffling(tmp_path):
     assert diff
 
 
+def test_llff_num_tgt_views(tmp_path):
+    """k targets per source flatten into k batch slots (the wired
+    data.num_tgt_views; reference caps it at 1, synthesis_task.py:203-204)."""
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=8)
+    cfg = _llff_cfg(str(tmp_path)).replace(**{"data.num_tgt_views": 2})
+    ds = LLFFDataset(cfg, "train", global_batch=4)
+    assert len(ds) == 4  # 8 sources / (4 slots / 2 views) = 4 steps
+    b = next(iter(ds.epoch(0)))
+    assert b["src_img"].shape == (4, 64, 64, 3)
+    # slots [0,1] share a source, [2,3] share the next one
+    np.testing.assert_array_equal(b["src_img"][0], b["src_img"][1])
+    np.testing.assert_array_equal(b["src_img"][2], b["src_img"][3])
+    # ...but supervise different targets
+    assert not np.array_equal(b["tgt_img"][0], b["tgt_img"][1])
+    assert not np.array_equal(b["tgt_img"][2], b["tgt_img"][3])
+
+    with pytest.raises(ValueError, match="num_tgt_views"):
+        LLFFDataset(cfg, "train", global_batch=3)  # 2 does not divide 3
+
+
 def test_llff_val_targets_deterministic(llff_root):
     root, _ = llff_root
     # val reads images_val; synthesize by copying the folder name

