@@ -6,14 +6,17 @@ S=32 planes, ResNet-50 encoder, per-device batch 2, full 4-scale loss +
 backward + Adam update per step, bf16 conv stacks. Data is the synthetic
 two-view scene (procedural — measures compute, not disk).
 
-Baseline denominator: the reference repo publishes no throughput anywhere
-(SURVEY.md §6); the north star is >=4x PyTorch-V100 imgs/sec. Until the
-reference recipe is timed on a real V100 (BASELINE.md action item), we use
-an ESTIMATE of 3.0 imgs/sec for PyTorch on one V100-16GB (batch 2 at
-~0.6-0.7 s/step for ResNet-50 + BxS=64 U-Net decoder + 4-scale grid_sample
-supervision), so vs_baseline = imgs_per_sec / 3.0.
+Auditability: alongside raw imgs/sec the JSON carries the compiled
+executable's own FLOP count (XLA cost analysis) and the resulting MFU
+against the chip's published peak, so the throughput claim can be checked
+without trusting any external estimate. `vs_baseline` is null: the reference
+repo publishes no throughput anywhere (SURVEY.md §6) and no GPU exists here
+to measure its recipe on, so there is no honest denominator — the north-star
+comparison (>=4x PyTorch-V100, BASELINE.md) awaits a measured V100 number.
 
-Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly one JSON line:
+  {"metric", "value", "unit", "vs_baseline", "flops_per_step",
+   "model_tflops_per_sec", "mfu", "device", "note"}
 """
 
 from __future__ import annotations
@@ -22,10 +25,47 @@ import json
 import sys
 import time
 
-V100_IMGS_PER_SEC_ESTIMATE = 3.0
 BATCH = 2
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
+
+# Published per-chip dense peak FLOP/s (bf16 unless noted). Sources: Google
+# Cloud TPU docs / "How to Scale Your Model"; keyed by jax device_kind.
+_CHIP_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 137e12,  # v4i
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,       # v5p (kept after the longer v5-lite/v5e keys)
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,       # ironwood, fp8-capable; bf16 peak
+}
+
+
+def chip_peak_flops(device_kind: str) -> float | None:
+    if device_kind in _CHIP_PEAK_FLOPS:
+        return _CHIP_PEAK_FLOPS[device_kind]
+    # prefix match tolerates suffixes like "TPU v4 (podslice)"
+    for kind, peak in sorted(_CHIP_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if device_kind.startswith(kind):
+            return peak
+    return None
+
+
+def executable_flops(compiled) -> float | None:
+    """FLOPs of one step from XLA's own cost analysis of the executable."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # some backends wrap in a list
+            cost = cost[0]
+        flops = cost.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:  # pragma: no cover - backend-dependent surface
+        return None
 
 
 def main() -> None:
@@ -56,33 +96,67 @@ def main() -> None:
     batch_np.pop("src_depth")
     batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
 
+    def force(state, loss_dict) -> float:
+        """Ground-truth completion barrier: host-fetch values that depend on
+        the full step (loss = forward graph, a param leaf = backward +
+        optimizer update). jax.block_until_ready returns early over this
+        environment's tunneled TPU backend — timing with it measured dispatch,
+        not execution (the r01/r02 imgs/sec artifacts were inflated by
+        exactly this)."""
+        leaf = jax.tree_util.tree_leaves(state.params)[0]
+        return float(loss_dict["loss"]) + float(jnp.sum(leaf))
+
+    def compile_and_warm(state, step):
+        compiled = step.lower(state, batch).compile()
+        for _ in range(WARMUP_STEPS):
+            state, loss_dict = compiled(state, batch)
+        force(state, loss_dict)
+        return compiled, state, loss_dict
+
     state, step = build(remat=False)
     try:
-        for _ in range(WARMUP_STEPS):
-            state, loss_dict = step(state, batch)
-        jax.block_until_ready(loss_dict["loss"])
+        compiled, state, loss_dict = compile_and_warm(state, step)
     except Exception as e:  # noqa: BLE001 - HBM OOM => retry with remat
         if "RESOURCE_EXHAUSTED" not in str(e).upper().replace(" ", "_"):
             raise
         print(f"# OOM without remat, retrying with remat_decoder ({e})",
               file=sys.stderr)
         state, step = build(remat=True)
-        for _ in range(WARMUP_STEPS):
-            state, loss_dict = step(state, batch)
-        jax.block_until_ready(loss_dict["loss"])
+        compiled, state, loss_dict = compile_and_warm(state, step)
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
-        state, loss_dict = step(state, batch)
-    jax.block_until_ready(loss_dict["loss"])
+        state, loss_dict = compiled(state, batch)
+    force(state, loss_dict)
     elapsed = time.perf_counter() - t0
 
     imgs_per_sec = BATCH * MEASURE_STEPS / elapsed
+    flops_per_step = executable_flops(compiled)
+    device = jax.devices()[0]
+    peak = chip_peak_flops(device.device_kind)
+    model_flops_per_sec = (
+        flops_per_step * MEASURE_STEPS / elapsed if flops_per_step else None
+    )
+    mfu = (
+        round(model_flops_per_sec / peak, 4)
+        if model_flops_per_sec and peak else None
+    )
     print(json.dumps({
         "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 3),
         "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / V100_IMGS_PER_SEC_ESTIMATE, 3),
+        "vs_baseline": None,
+        "flops_per_step": flops_per_step,
+        "model_tflops_per_sec": (
+            round(model_flops_per_sec / 1e12, 3) if model_flops_per_sec else None
+        ),
+        "mfu": mfu,
+        "device": device.device_kind,
+        "note": (
+            "vs_baseline awaits a measured reference denominator (the "
+            "reference repo publishes no throughput, SURVEY.md §6); mfu = "
+            "XLA cost-analysis FLOPs / published chip peak"
+        ),
     }))
 
 

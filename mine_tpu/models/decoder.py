@@ -60,7 +60,7 @@ class ConvBlock(nn.Module):
     """Conv3x3 -> BN -> ELU (monodepth2/layers.py:106-120)."""
 
     features: int
-    axis_name: str | None = None
+    axis_name: str | tuple[str, ...] | None = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -75,7 +75,7 @@ class ConvBNLeaky(nn.Module):
 
     features: int
     kernel: int
-    axis_name: str | None = None
+    axis_name: str | tuple[str, ...] | None = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -87,15 +87,34 @@ class ConvBNLeaky(nn.Module):
         return nn.leaky_relu(x, negative_slope=0.1)
 
 
+def join_axis_names(
+    a: str | tuple[str, ...] | None, b: str | tuple[str, ...] | None
+) -> str | tuple[str, ...] | None:
+    """Combine BN sync-axis specs (None-aware)."""
+    ta = (a,) if isinstance(a, str) else tuple(a or ())
+    tb = (b,) if isinstance(b, str) else tuple(b or ())
+    joined = ta + tb
+    return joined if joined else None
+
+
 class MPIDecoder(nn.Module):
-    """features (5 x NHWC) + disparity (B,S) -> {scale: (B,S,h,w,4)} MPIs."""
+    """features (5 x NHWC) + disparity (B,S) -> {scale: (B,S,h,w,4)} MPIs.
+
+    `plane_axis`: mesh axis the S planes shard over (SURVEY.md §5.7), if any.
+    Only layers DOWNSTREAM of the disparity concat vary over that axis, so
+    only the up-stage BNs include it in their stat sync; the encoder-extension
+    BNs see plane-replicated activations and sync over `axis_name` alone
+    (pooling identical replicas would change nothing but waste a collective —
+    and strict varying-axes checking rejects it outright).
+    """
 
     multires: int = 10  # model.pos_encoding_multires (params_default.yaml:24)
     use_alpha: bool = False
     scales: Sequence[int] = (0, 1, 2, 3)
     use_skips: bool = True
     sigma_dropout_rate: float = 0.0
-    axis_name: str | None = None
+    axis_name: str | tuple[str, ...] | None = None
+    plane_axis: str | None = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -158,10 +177,13 @@ class MPIDecoder(nn.Module):
         return outputs
 
     def _stage(self, i: int, train: bool):
-        """One decoder up-stage (depth_decoder.py:120-126)."""
-        up0 = ConvBlock(NUM_CH_DEC[i], self.axis_name, self.dtype,
+        """One decoder up-stage (depth_decoder.py:120-126). Activations here
+        carry the per-plane conditioning, so BN stats pool over the plane
+        mesh axis too (matching the unsharded B*S batch statistics)."""
+        stage_axes = join_axis_names(self.axis_name, self.plane_axis)
+        up0 = ConvBlock(NUM_CH_DEC[i], stage_axes, self.dtype,
                         name=f"upconv_{i}_0")
-        up1 = ConvBlock(NUM_CH_DEC[i], self.axis_name, self.dtype,
+        up1 = ConvBlock(NUM_CH_DEC[i], stage_axes, self.dtype,
                         name=f"upconv_{i}_1")
 
         def run(x: Array, skip: Array | None) -> Array:

@@ -33,7 +33,11 @@ class MPINetwork(nn.Module):
     use_alpha: bool = False
     scales: Sequence[int] = (0, 1, 2, 3)
     sigma_dropout_rate: float = 0.0
-    axis_name: str | None = None
+    axis_name: str | None = None  # data-replica BN sync axis
+    # mesh axis the S planes shard over (SURVEY.md §5.7); the encoder and the
+    # decoder's pre-conditioning layers see plane-replicated activations, so
+    # only the decoder's post-conditioning BNs sync over it (decoder.py)
+    plane_axis: str | None = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -45,7 +49,8 @@ class MPINetwork(nn.Module):
         return MPIDecoder(
             multires=self.multires, use_alpha=self.use_alpha,
             scales=self.scales, sigma_dropout_rate=self.sigma_dropout_rate,
-            axis_name=self.axis_name, dtype=self.dtype, name="decoder",
+            axis_name=self.axis_name, plane_axis=self.plane_axis,
+            dtype=self.dtype, name="decoder",
         )(feats, disparity, train)
 
 

@@ -19,7 +19,7 @@ class SyncBatchNorm(nn.Module):
     uses running averages and must not emit collectives.
     """
 
-    axis_name: str | None = None
+    axis_name: str | tuple[str, ...] | None = None
     dtype: Any = jnp.float32
 
     @nn.compact

@@ -30,9 +30,12 @@ def load_backbone_npz(path: str) -> dict[str, dict[str, np.ndarray]]:
     raw = np.load(path)
     out: dict[str, dict[str, np.ndarray]] = {c: {} for c in _COLLECTIONS}
     for key in raw.files:
-        coll, rest = key.split("/", 1)
-        if coll not in _COLLECTIONS or not rest.startswith("backbone/"):
-            raise ValueError(f"{path}: unexpected key {key!r}")
+        coll, sep, rest = key.partition("/")
+        if not sep or coll not in _COLLECTIONS or not rest.startswith("backbone/"):
+            raise ValueError(
+                f"{path}: unexpected key {key!r} — not a "
+                "tools/convert_resnet.py artifact?"
+            )
         out[coll][rest[len("backbone/"):]] = raw[key]
     return out
 

@@ -21,11 +21,14 @@ from mine_tpu.ops.homography import (
     homography_sample,
 )
 from mine_tpu.ops.mpi_render import (
+    Compositor,
+    DENSE_COMPOSITOR,
     alpha_composition,
     plane_volume_rendering,
     weighted_sum_mpi,
     render,
     render_tgt_rgb_depth,
+    warp_mpi_to_tgt,
 )
 from mine_tpu.ops.sampling import (
     uniform_disparity_from_linspace_bins,

@@ -10,6 +10,8 @@ by mine_tpu/parallel/plane_sharding.py with an explicit cross-device prefix.
 
 from __future__ import annotations
 
+from typing import Callable, NamedTuple
+
 import jax.numpy as jnp
 from jax import Array
 
@@ -101,7 +103,7 @@ def render(
     return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
 
 
-def render_tgt_rgb_depth(
+def warp_mpi_to_tgt(
     mpi_rgb_src: Array,
     mpi_sigma_src: Array,
     mpi_disparity_src: Array,
@@ -109,21 +111,14 @@ def render_tgt_rgb_depth(
     g_tgt_src: Array,
     k_src_inv: Array,
     k_tgt: Array,
-    use_alpha: bool = False,
-    is_bg_depth_inf: bool = False,
-) -> tuple[Array, Array, Array]:
-    """Warp the source MPI into the target camera and composite
-    (mpi_rendering.py:181-241).
+) -> tuple[Array, Array, Array, Array]:
+    """Homography-warp every source plane into the target camera
+    (the per-plane half of mpi_rendering.py:181-241 — embarrassingly parallel
+    over S, so a plane-sharded mesh runs it on local planes unchanged).
 
-    Args:
-      mpi_rgb_src: (B, S, H, W, 3); mpi_sigma_src: (B, S, H, W, 1).
-      mpi_disparity_src: (B, S).
-      xyz_tgt: (B, S, H, W, 3) plane xyz already in the target frame — warped
-        alongside rgb/sigma because compositing needs target-frame distances.
-      g_tgt_src: (B, 4, 4); k_src_inv/k_tgt: (B, 3, 3).
-    Returns:
-      tgt_rgb (B, H, W, 3), tgt_depth (B, H, W, 1),
-      tgt_mask (B, H, W, 1) — number of planes whose warp lands in-FoV.
+    Shapes as in render_tgt_rgb_depth (S may be a local plane chunk).
+    Returns (tgt_rgb, tgt_sigma, tgt_xyz, valid) with behind-camera sigma
+    already zeroed (mpi_rendering.py:232-235); valid is (B, S, H, W).
     """
     b, s, h, w, _ = mpi_rgb_src.shape
     depth = 1.0 / mpi_disparity_src  # (B, S)
@@ -150,9 +145,57 @@ def render_tgt_rgb_depth(
     # planes behind the target camera contribute nothing
     # (mpi_rendering.py:232-235)
     tgt_sigma = jnp.where(tgt_xyz[..., 2:3] >= 0.0, tgt_sigma, 0.0)
+    return tgt_rgb, tgt_sigma, tgt_xyz, valid
 
+
+def render_tgt_rgb_depth(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    xyz_tgt: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array]:
+    """Warp the source MPI into the target camera and composite
+    (mpi_rendering.py:181-241).
+
+    Args:
+      mpi_rgb_src: (B, S, H, W, 3); mpi_sigma_src: (B, S, H, W, 1).
+      mpi_disparity_src: (B, S).
+      xyz_tgt: (B, S, H, W, 3) plane xyz already in the target frame — warped
+        alongside rgb/sigma because compositing needs target-frame distances.
+      g_tgt_src: (B, 4, 4); k_src_inv/k_tgt: (B, 3, 3).
+    Returns:
+      tgt_rgb (B, H, W, 3), tgt_depth (B, H, W, 1),
+      tgt_mask (B, H, W, 1) — number of planes whose warp lands in-FoV.
+    """
+    tgt_rgb, tgt_sigma, tgt_xyz, valid = warp_mpi_to_tgt(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src, xyz_tgt,
+        g_tgt_src, k_src_inv, k_tgt,
+    )
     tgt_rgb_syn, tgt_depth_syn, _, _ = render(
         tgt_rgb, tgt_sigma, tgt_xyz, use_alpha=use_alpha, is_bg_depth_inf=is_bg_depth_inf
     )
     tgt_mask = jnp.sum(valid.astype(mpi_rgb_src.dtype), axis=1)[..., None]
     return tgt_rgb_syn, tgt_depth_syn, tgt_mask
+
+
+class Compositor(NamedTuple):
+    """The S-axis reduction primitives the loss graph composites through.
+
+    The default (DENSE_COMPOSITOR) reduces over a whole in-memory plane axis;
+    mine_tpu/parallel/plane_sharding.py builds the plane-sharded twin whose
+    reductions cross the mesh's `plane` axis. Swapping this triple is the
+    entire difference between the unsharded and plane-parallel train steps —
+    the loss graph itself is oblivious (SURVEY.md §5.7).
+    """
+
+    render: Callable
+    weighted_sum_mpi: Callable
+    render_tgt_rgb_depth: Callable
+
+
+DENSE_COMPOSITOR = Compositor(render, weighted_sum_mpi, render_tgt_rgb_depth)

@@ -12,10 +12,14 @@ from mine_tpu.parallel.mesh import (
 from mine_tpu.parallel.data_parallel import (
     make_parallel_train_step,
     make_parallel_eval_step,
+    model_axes,
     replicate_state,
 )
 from mine_tpu.parallel.plane_sharding import (
+    plane_compositor,
     sharded_alpha_composition,
     sharded_plane_volume_rendering,
+    sharded_render,
+    sharded_render_tgt_rgb_depth,
     sharded_weighted_sum_mpi,
 )

@@ -19,24 +19,56 @@ from jax import shard_map
 
 from mine_tpu.config import Config
 from mine_tpu.models import MPINetwork
-from mine_tpu.parallel.mesh import DATA_AXIS
+from mine_tpu.ops import DENSE_COMPOSITOR
+from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS
+from mine_tpu.parallel.plane_sharding import plane_compositor
 from mine_tpu.training.step import make_eval_step, make_train_step
 from mine_tpu.training.state import TrainState
 
 _REPL = P()  # replicated
-_BATCH = P(DATA_AXIS)  # shard axis 0 over data
+_BATCH = P(DATA_AXIS)  # shard axis 0 over data, replicate over plane
+
+
+def model_axes(mesh: Mesh) -> dict:
+    """build_model kwargs for a model living on this mesh: BN syncs over
+    `data` always; under plane sharding the decoder's post-conditioning BNs
+    additionally pool over `plane` (its effective batch B*S splits across
+    both axes — models/decoder.py)."""
+    n_plane = mesh.shape.get(PLANE_AXIS, 1)
+    return {
+        "axis_name": DATA_AXIS,
+        "plane_axis": PLANE_AXIS if n_plane > 1 else None,
+    }
+
+
+def _plane_args(cfg: Config, mesh: Mesh) -> dict:
+    """plane_axis/compositor kwargs for make_{train,eval}_step, validated."""
+    n_plane = mesh.shape.get(PLANE_AXIS, 1)
+    if n_plane <= 1:
+        return {"plane_axis": None, "compositor": DENSE_COMPOSITOR}
+    if cfg.mpi.num_bins_coarse % n_plane:
+        raise ValueError(
+            f"mpi.num_bins_coarse={cfg.mpi.num_bins_coarse} must divide by "
+            f"the plane-axis size {n_plane}"
+        )
+    return {"plane_axis": PLANE_AXIS, "compositor": plane_compositor(PLANE_AXIS)}
 
 
 def make_parallel_train_step(
     cfg: Config, model: MPINetwork, tx: optax.GradientTransformation, mesh: Mesh
 ) -> Callable:
-    """jit(shard_map(train_step)): state replicated, batch data-sharded.
+    """jit(shard_map(train_step)): state replicated, batch sharded over
+    `data` and replicated over `plane`; with a plane axis of size > 1, each
+    device runs the decoder + renderer on its S_local plane chunk and the
+    compositing reductions cross the plane axis (plane_sharding.py).
 
-    The model must have been built with axis_name=DATA_AXIS (build_model) so
-    BN stats sync; the step pmeans the loss pre-grad and logged losses
-    post-grad (step.py).
+    The model must have been built with axis_name=model_axis_name(mesh)
+    (build_model) so BN stats sync; the step pmeans the loss pre-grad over
+    `data` and logged losses post-grad (step.py).
     """
-    step = make_train_step(cfg, model, tx, axis_name=DATA_AXIS)
+    step = make_train_step(
+        cfg, model, tx, axis_name=DATA_AXIS, **_plane_args(cfg, mesh)
+    )
     sharded = shard_map(
         step,
         mesh=mesh,
@@ -54,7 +86,10 @@ def make_parallel_eval_step(
 ) -> Callable:
     """jit(shard_map(eval_step)): losses pmean'd to replicated; per-replica
     visualizations stay batch-sharded (gather only what gets logged)."""
-    step = make_eval_step(cfg, model, lpips_params=lpips_params, axis_name=DATA_AXIS)
+    step = make_eval_step(
+        cfg, model, lpips_params=lpips_params, axis_name=DATA_AXIS,
+        **_plane_args(cfg, mesh),
+    )
     sharded = shard_map(
         step,
         mesh=mesh,

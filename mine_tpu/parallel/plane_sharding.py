@@ -18,11 +18,18 @@ disparity convention as ops/mpi_render.py).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
-from mine_tpu.ops.mpi_render import _BG_DIST, _shifted_exclusive
+from mine_tpu.ops.mpi_render import (
+    _BG_DIST,
+    Compositor,
+    _shifted_exclusive,
+    warp_mpi_to_tgt,
+)
 
 
 def _exclusive_device_prefix(local_total: Array, axis_name: str) -> Array:
@@ -128,3 +135,90 @@ def sharded_weighted_sum_mpi(
     else:
         depth_out = z_term / (weights_sum + 1.0e-5)
     return rgb_out, depth_out
+
+
+def sharded_render(
+    rgb: Array,
+    sigma: Array,
+    xyz: Array,
+    axis_name: str,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """Sigma-vs-alpha compositing dispatch on local plane chunks (unsharded
+    twin: ops.render; reference mpi_rendering.py:7-20).
+
+    Composited outputs come back psum-replicated over the plane axis; blend
+    weights and compositing weights stay local (B, S_local, H, W, 1)."""
+    if not use_alpha:
+        return sharded_plane_volume_rendering(
+            rgb, sigma, xyz, axis_name, is_bg_depth_inf
+        )
+    imgs_syn, weights = sharded_alpha_composition(sigma, rgb, axis_name)
+    depth_syn, _ = sharded_alpha_composition(sigma, xyz[..., 2:3], axis_name)
+    return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
+
+
+def sharded_render_tgt_rgb_depth(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    xyz_tgt: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    axis_name: str,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array]:
+    """Plane-sharded target-view render (unsharded twin:
+    ops.render_tgt_rgb_depth; reference mpi_rendering.py:181-241).
+
+    The homography warp is per-plane local work and runs unchanged on each
+    device's chunk; only the composite and the in-FoV plane count cross the
+    plane axis.
+    """
+    tgt_rgb, tgt_sigma, tgt_xyz, valid = warp_mpi_to_tgt(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src, xyz_tgt,
+        g_tgt_src, k_src_inv, k_tgt,
+    )
+    tgt_rgb_syn, tgt_depth_syn, _, _ = sharded_render(
+        tgt_rgb, tgt_sigma, tgt_xyz, axis_name,
+        use_alpha=use_alpha, is_bg_depth_inf=is_bg_depth_inf,
+    )
+    tgt_mask = lax.psum(
+        jnp.sum(valid.astype(mpi_rgb_src.dtype), axis=1), axis_name
+    )[..., None]
+    return tgt_rgb_syn, tgt_depth_syn, tgt_mask
+
+
+def plane_compositor(axis_name: str) -> Compositor:
+    """The plane-sharded Compositor: drop-in for ops.DENSE_COMPOSITOR inside
+    a shard_map whose `axis_name` carries the S-plane axis. Swapping this in
+    is the whole difference between the unsharded and plane-parallel loss
+    graphs (training/step.py)."""
+    return Compositor(
+        render=partial(_render_kw, axis_name),
+        weighted_sum_mpi=partial(_weighted_sum_kw, axis_name),
+        render_tgt_rgb_depth=partial(_render_tgt_kw, axis_name),
+    )
+
+
+# keyword-compatible adapters: the loss graph calls the Compositor fields with
+# the unsharded ops' signatures (use_alpha=..., is_bg_depth_inf=...)
+def _render_kw(axis_name, rgb, sigma, xyz, use_alpha=False, is_bg_depth_inf=False):
+    return sharded_render(rgb, sigma, xyz, axis_name, use_alpha, is_bg_depth_inf)
+
+
+def _weighted_sum_kw(axis_name, rgb, xyz, weights, is_bg_depth_inf=False):
+    return sharded_weighted_sum_mpi(rgb, xyz, weights, axis_name, is_bg_depth_inf)
+
+
+def _render_tgt_kw(
+    axis_name, mpi_rgb, mpi_sigma, disparity, xyz_tgt, g, k_src_inv, k_tgt,
+    use_alpha=False, is_bg_depth_inf=False,
+):
+    return sharded_render_tgt_rgb_depth(
+        mpi_rgb, mpi_sigma, disparity, xyz_tgt, g, k_src_inv, k_tgt,
+        axis_name, use_alpha, is_bg_depth_inf,
+    )

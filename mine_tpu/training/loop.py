@@ -20,11 +20,11 @@ from mine_tpu.config import Config
 from mine_tpu.data import prefetch
 from mine_tpu.losses import load_lpips_params
 from mine_tpu.parallel import (
-    DATA_AXIS,
     init_multihost,
     make_mesh,
     make_parallel_eval_step,
     make_parallel_train_step,
+    model_axes,
     replicate_state,
     shard_batch,
 )
@@ -58,8 +58,8 @@ class Trainer:
         self.mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
         self.logger = make_logger(workspace)
         self.writer = MetricWriter(workspace)
-        self.model = build_model(cfg, axis_name=DATA_AXIS)
-        self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
+        self.model = build_model(cfg, **model_axes(self.mesh))
+        self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape["data"]
         if jax.process_index() == 0:
             os.makedirs(workspace, exist_ok=True)
             ckpt.save_paired_config(cfg, workspace)

@@ -51,13 +51,20 @@ from mine_tpu.training.state import TrainState
 NO_DISP_SUPERVISION = ("flowers", "kitti_raw", "dtu")
 
 
-def build_model(cfg: Config, axis_name: str | None = None) -> MPINetwork:
+def build_model(
+    cfg: Config,
+    axis_name: str | None = None,
+    plane_axis: str | None = None,
+) -> MPINetwork:
+    """axis_name: data-replica BN sync axis; plane_axis: the S-plane mesh
+    axis under plane sharding (use parallel.model_axes(mesh) to derive both)."""
     return MPINetwork(
         num_layers=cfg.model.num_layers,
         multires=cfg.model.pos_encoding_multires,
         use_alpha=cfg.mpi.use_alpha,
         sigma_dropout_rate=cfg.mpi.sigma_dropout_rate,
         axis_name=axis_name,
+        plane_axis=plane_axis,
         dtype=jnp.bfloat16 if cfg.model.dtype == "bfloat16" else jnp.float32,
     )
 
@@ -94,12 +101,31 @@ def forward_coarse_to_fine(
     key_fine: Array | None = None,
     key_dropout: Array | None = None,
     train: bool = True,
+    plane_axis: str | None = None,
 ) -> tuple[dict[int, Array], Array, Any]:
     """Full forward incl. optional coarse-to-fine plane refinement
     (mpi_rendering.py:244-276). All shipped configs run the single-pass path
-    (num_bins_fine: 0, params_default.yaml:30)."""
+    (num_bins_fine: 0, params_default.yaml:30).
+
+    With `plane_axis` (inside shard_map over a mesh carrying that axis), the
+    full S-plane disparity list is sampled identically on every plane device
+    (the key must not be folded by plane index) and each device runs the
+    decoder on its own S_local contiguous chunk — the activation memory of
+    decoder + renderer divides by the plane-axis size (SURVEY.md §5.7).
+    """
     b, h, w, _ = src_img.shape
     disparity = make_disparity_list(cfg, key_disparity, b)
+    if plane_axis is not None:
+        if cfg.mpi.num_bins_fine > 0:
+            raise NotImplementedError(
+                "coarse-to-fine plane refinement needs the global plane PDF; "
+                "it is not supported under plane sharding (and no shipped "
+                "reference config enables it, params_default.yaml:30)"
+            )
+        n_plane = lax.axis_size(plane_axis)
+        s_local = cfg.mpi.num_bins_coarse // n_plane
+        start = lax.axis_index(plane_axis) * s_local
+        disparity = lax.dynamic_slice_in_dim(disparity, start, s_local, axis=1)
 
     stats_cell = [batch_stats]
 
@@ -145,6 +171,7 @@ def render_novel_view(
     k_src_inv: Array,
     k_tgt: Array,
     scale_factor: Array | None = None,
+    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
 ) -> dict[str, Array]:
     """Warp + composite the source MPI into the target camera
     (synthesis_task.py:455-494). scale_factor divides the pose translation
@@ -157,7 +184,7 @@ def render_novel_view(
     grid = ops.homogeneous_pixel_grid(h, w)
     xyz_src = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
     xyz_tgt = ops.get_tgt_xyz_from_plane_disparity(xyz_src, g_tgt_src)
-    tgt_rgb_syn, tgt_depth_syn, tgt_mask = ops.render_tgt_rgb_depth(
+    tgt_rgb_syn, tgt_depth_syn, tgt_mask = compositor.render_tgt_rgb_depth(
         mpi_rgb,
         mpi_sigma,
         disparity,
@@ -190,8 +217,14 @@ def loss_fcn_per_scale(
     scale_factor: Array | None,
     is_val: bool,
     lpips_params: dict | None,
+    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
 ) -> tuple[dict[str, Array], dict[str, Array], Array]:
     """One scale of the supervision graph (synthesis_task.py:234-390).
+
+    All S-axis reductions go through `compositor` — the plane-sharded twin
+    makes this same graph run on S_local plane chunks with psum composites
+    (mine_tpu/parallel/plane_sharding.py); everything downstream of the
+    composited (B, H, W) maps is plane-replicated and unchanged.
 
     Returns (loss_dict, visualization_dict, scale_factor).
     """
@@ -212,7 +245,7 @@ def loss_fcn_per_scale(
 
     grid = ops.homogeneous_pixel_grid(src_img.shape[1], src_img.shape[2])
     xyz_src = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
-    src_syn, src_depth, blend_weights, weights = ops.render(
+    src_syn, src_depth, blend_weights, weights = compositor.render(
         mpi_rgb, mpi_sigma, xyz_src,
         use_alpha=cfg.mpi.use_alpha, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
     )
@@ -220,7 +253,7 @@ def loss_fcn_per_scale(
         # visible-from-src parts take the real pixels; occluded parts keep the
         # network's rgb (synthesis_task.py:282-290)
         mpi_rgb = blend_weights * src_img[:, None] + (1.0 - blend_weights) * mpi_rgb
-        src_syn, src_depth = ops.weighted_sum_mpi(
+        src_syn, src_depth = compositor.weighted_sum_mpi(
             mpi_rgb, xyz_src, weights, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf
         )
     src_disparity_syn = 1.0 / src_depth
@@ -243,6 +276,7 @@ def loss_fcn_per_scale(
     render_results = render_novel_view(
         cfg, mpi_rgb, mpi_sigma, disparity,
         batch["g_tgt_src"], k_src_inv, k_tgt, scale_factor=scale_factor,
+        compositor=compositor,
     )
     tgt_syn = render_results["tgt_imgs_syn"]
     tgt_disparity_syn = render_results["tgt_disparity_syn"]
@@ -332,6 +366,8 @@ def loss_fcn(
     is_val: bool,
     lpips_params: dict | None = None,
     train: bool = True,
+    plane_axis: str | None = None,
+    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
 ) -> tuple[Array, dict[str, Array], dict[str, Array], Any]:
     """Forward + all 4 scale losses + multi-scale aggregation
     (synthesis_task.py:392-418).
@@ -339,12 +375,19 @@ def loss_fcn(
     Returns (total_loss, loss_dict, visualization_dict, new_batch_stats).
     """
     key_disp, key_fine, key_dropout = jax.random.split(key, 3)
+    if plane_axis is not None:
+        # the disparity key MUST stay shared across plane devices (each
+        # slices one full-S list), but dropout masks must be i.i.d. per
+        # plane chunk — an unfolded key would drop the same depth band on
+        # every device
+        key_dropout = jax.random.fold_in(key_dropout, lax.axis_index(plane_axis))
     k_src_inv = ops.inverse_3x3(batch["k_src"])
     mpis, disparity, new_stats = forward_coarse_to_fine(
         cfg, model, params, batch_stats, batch["src_img"], k_src_inv,
         key_disparity=key_disp, key_fine=key_fine,
         key_dropout=key_dropout if cfg.mpi.sigma_dropout_rate > 0 else None,
         train=train,
+        plane_axis=plane_axis,
     )
 
     scale_factor = None
@@ -352,7 +395,7 @@ def loss_fcn(
     for scale in range(4):
         ld, vz, scale_factor = loss_fcn_per_scale(
             cfg, scale, batch, mpis[scale], disparity, scale_factor,
-            is_val=is_val, lpips_params=lpips_params,
+            is_val=is_val, lpips_params=lpips_params, compositor=compositor,
         )
         loss_dicts.append(ld)
         viz_dicts.append(vz)
@@ -374,6 +417,8 @@ def make_train_step(
     model: MPINetwork,
     tx: optax.GradientTransformation,
     axis_name: str | None = None,
+    plane_axis: str | None = None,
+    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
 ) -> Callable[[TrainState, dict[str, Array]], tuple[TrainState, dict[str, Array]]]:
     """Build the train-step function (one optimizer update,
     synthesis_task.py:627-635 under jit).
@@ -383,6 +428,16 @@ def make_train_step(
     differentiation (which makes AD emit the global-batch gradient — the
     DDP-allreduce + SyncBN equivalent, SURVEY.md §2.4), logged losses
     pmean'd after.
+
+    With `plane_axis` (+ the matching plane-sharded `compositor`), the S
+    plane axis additionally shards over that mesh axis (SURVEY.md §5.7). The
+    RNG folds the data index only — plane devices of one data replica MUST
+    share a key so they sample the same full-S disparity list and slice it.
+    The loss is NOT pmean'd over the plane axis: each plane device's params
+    cotangent carries only its local planes' contribution, and shard_map's
+    automatic psum of the replicated-param cotangent across the mesh sums
+    them into the exact full-S gradient (a plane pmean would shrink it by
+    the plane count).
     """
 
     def train_step(state: TrainState, batch: dict[str, Array]):
@@ -394,6 +449,7 @@ def make_train_step(
             total, loss_dict, _viz, new_stats = loss_fcn(
                 cfg, model, params, state.batch_stats, batch, rng,
                 is_val=False, train=True,
+                plane_axis=plane_axis, compositor=compositor,
             )
             # The cross-replica gradient reduction happens HERE, by averaging
             # the scalar loss before differentiation — not by pmean-ing grads
@@ -427,6 +483,8 @@ def make_eval_step(
     model: MPINetwork,
     lpips_params: dict | None = None,
     axis_name: str | None = None,
+    plane_axis: str | None = None,
+    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
 ):
     """Eval step: same loss graph, eval-mode BN, no update
     (synthesis_task.py:496-527). Runs on every replica (the reference runs
@@ -438,6 +496,7 @@ def make_eval_step(
         _total, loss_dict, viz, _ = loss_fcn(
             cfg, model, state.params, state.batch_stats, batch, key,
             is_val=True, lpips_params=lpips_params, train=False,
+            plane_axis=plane_axis, compositor=compositor,
         )
         if axis_name is not None:
             loss_dict = lax.pmean(loss_dict, axis_name)

@@ -15,10 +15,12 @@ from mine_tpu.parallel import (
     DATA_AXIS,
     make_mesh,
     make_parallel_train_step,
+    model_axes,
     replicate_state,
     shard_batch,
     sharded_alpha_composition,
     sharded_plane_volume_rendering,
+    sharded_render_tgt_rgb_depth,
 )
 from mine_tpu.training import build_model, init_state, make_train_step
 
@@ -114,6 +116,110 @@ def test_sharded_volume_rendering_grads_finite(rng):
     grads = jax.jit(grad_fn)(rgb, sigma, xyz)
     for g, name in zip(grads, ["rgb", "sigma", "xyz"]):
         assert bool(jnp.all(jnp.isfinite(g))), f"non-finite grad in {name}"
+
+
+def test_sharded_render_tgt_matches_unsharded(rng):
+    """Plane-sharded target-view warp+composite == unsharded twin."""
+    from mine_tpu.ops import (
+        get_src_xyz_from_plane_disparity,
+        get_tgt_xyz_from_plane_disparity,
+        homogeneous_pixel_grid,
+        inverse_3x3,
+        render_tgt_rgb_depth,
+    )
+
+    b, s, h, w = 1, 8, 8, 10
+    rgb = jnp.asarray(rng.uniform(size=(b, s, h, w, 3)).astype(np.float32))
+    sigma = jnp.asarray(rng.uniform(0.1, 2.0, size=(b, s, h, w, 1)).astype(np.float32))
+    k = jnp.asarray(
+        np.array([[12.0, 0, 5.0], [0, 12.0, 4.0], [0, 0, 1.0]], np.float32)
+    )[None]
+    k_inv = inverse_3x3(k)
+    disparity = jnp.asarray(np.linspace(1.0, 0.1, s, dtype=np.float32))[None]
+    g = np.eye(4, dtype=np.float32)
+    g[:3, 3] = [0.05, -0.02, 0.01]
+    g = jnp.asarray(g)[None]
+
+    xyz_src = get_src_xyz_from_plane_disparity(
+        homogeneous_pixel_grid(h, w), disparity, k_inv
+    )
+    xyz_tgt = get_tgt_xyz_from_plane_disparity(xyz_src, g)
+    want = render_tgt_rgb_depth(rgb, sigma, disparity, xyz_tgt, g, k_inv, k)
+
+    mesh = _plane_mesh(4)
+    fn = shard_map(
+        lambda r, sg, d, x: sharded_render_tgt_rgb_depth(
+            r, sg, d, x, g, k_inv, k, "plane"
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "plane"), P(None, "plane"), P(None, "plane"), P(None, "plane")),
+        out_specs=(P(), P(), P()),
+    )
+    got = jax.jit(fn)(rgb, sigma, disparity, xyz_tgt)
+    for g_, w_, name in zip(got, want, ["rgb", "depth", "mask"]):
+        np.testing.assert_allclose(
+            np.asarray(g_), np.asarray(w_), rtol=2e-5, atol=2e-5, err_msg=name
+        )
+
+
+@pytest.mark.slow
+def test_plane_parallel_step_matches_single_device():
+    """One full train step on a (2 data x 4 plane) mesh == the same step on
+    one device (VERDICT r2 #5): decoder runs on S_local=1 plane chunks, the
+    compositing reductions cross the plane axis, BN stats sync over both
+    axes, and shard_map's auto-psum of the replicated-param cotangent
+    reassembles the full-S gradient."""
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "model.num_layers": 18,
+        "model.dtype": "float32", "mpi.num_bins_coarse": 4,
+        "mpi.fix_disparity": True,  # removes per-replica sampling noise
+    })
+    import optax
+
+    tx = optax.sgd(0.1)
+    batch_np = make_synthetic_batch(2, 128, 128, n_points=16, seed=0)
+    batch_np.pop("src_depth")
+
+    model1 = build_model(cfg)
+    state1 = init_state(cfg, model1, tx, jax.random.PRNGKey(0))
+    step1 = jax.jit(make_train_step(cfg, model1, tx))
+    new1, loss1 = step1(state1, {k: jnp.asarray(v) for k, v in batch_np.items()})
+
+    mesh = make_mesh(data_parallel=2, plane_parallel=4)
+    assert model_axes(mesh) == {"axis_name": "data", "plane_axis": "plane"}
+    model8 = build_model(cfg, **model_axes(mesh))
+    state8 = init_state(cfg, model8, tx, jax.random.PRNGKey(0))
+    state8 = replicate_state(state8, mesh)
+    step8 = make_parallel_train_step(cfg, model8, tx, mesh)
+    params8_before = jax.device_get(state8.params)
+    new8, loss8 = step8(state8, shard_batch(mesh, batch_np))
+
+    assert float(loss8["loss"]) == pytest.approx(float(loss1["loss"]), rel=2e-4)
+    # same norm-level comparison (and rationale) as the DP equivalence test
+    updates1 = jax.tree.map(lambda n, o: n - o, new1.params, state1.params)
+    updates8 = jax.tree.map(
+        lambda n, o: n - jnp.asarray(o), new8.params, params8_before
+    )
+    for (p1, u1), (_, u8) in zip(
+        jax.tree_util.tree_leaves_with_path(updates1),
+        jax.tree_util.tree_leaves_with_path(updates8),
+    ):
+        diff = float(jnp.linalg.norm(u1 - u8))
+        ref = float(jnp.linalg.norm(u1))
+        if max(ref, float(jnp.linalg.norm(u8))) < 1e-3:
+            continue  # zero-effective-grad conv biases (see DP test)
+        assert diff <= 0.05 * ref, (
+            f"{jax.tree_util.keystr(p1)}: |Δu|={diff:.4g} vs |u|={ref:.4g}"
+        )
+    # BN batch_stats must also agree (stats pool over both mesh axes)
+    for (p1, s1), (_, s8) in zip(
+        jax.tree_util.tree_leaves_with_path(new1.batch_stats),
+        jax.tree_util.tree_leaves_with_path(new8.batch_stats),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(s1), np.asarray(jnp.asarray(s8)), rtol=1e-3, atol=1e-4,
+            err_msg=jax.tree_util.keystr(p1),
+        )
 
 
 @pytest.mark.slow
