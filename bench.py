@@ -29,11 +29,14 @@ BATCH = 2
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
-# Published per-chip dense peak FLOP/s (bf16 unless noted). Sources: Google
-# Cloud TPU docs / "How to Scale Your Model"; keyed by jax device_kind.
+# Published dense bf16 peak FLOP/s PER JAX DEVICE (what the executable and
+# its cost analysis run on). On v2/v3 a jax device is one core (half a chip:
+# 45/123 TFLOP per chip => 22.5/61.5 per core); v4 onward exposes one
+# megacore device per chip. Sources: Google Cloud TPU docs / "How to Scale
+# Your Model"; keyed by jax device_kind.
 _CHIP_PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.5e12,
     "TPU v4": 275e12,
     "TPU v4 lite": 137e12,  # v4i
     "TPU v5 lite": 197e12,  # v5e
@@ -47,6 +50,7 @@ _CHIP_PEAK_FLOPS = {
 
 
 def chip_peak_flops(device_kind: str) -> float | None:
+    """Peak FLOP/s of one jax device of this kind (None when unknown)."""
     if device_kind in _CHIP_PEAK_FLOPS:
         return _CHIP_PEAK_FLOPS[device_kind]
     # prefix match tolerates suffixes like "TPU v4 (podslice)"

@@ -20,6 +20,7 @@ from mine_tpu.config import Config
 from mine_tpu.data import prefetch
 from mine_tpu.losses import load_lpips_params
 from mine_tpu.parallel import (
+    DATA_AXIS,
     init_multihost,
     make_mesh,
     make_parallel_eval_step,
@@ -59,7 +60,7 @@ class Trainer:
         self.logger = make_logger(workspace)
         self.writer = MetricWriter(workspace)
         self.model = build_model(cfg, **model_axes(self.mesh))
-        self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape["data"]
+        self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
         if jax.process_index() == 0:
             os.makedirs(workspace, exist_ok=True)
             ckpt.save_paired_config(cfg, workspace)
@@ -174,6 +175,19 @@ class Trainer:
                     or global_step % cfg.training.eval_interval == 0
                 ):
                     last_val = self.evaluate(eval_step, state, val_ds, global_step)
+
+            # end-of-epoch summary from the meters (log-interval samples,
+            # weighted by interval) — the running averages the reference
+            # accumulates but never reports (synthesis_task.py:146-167)
+            if any(m.count for m in meters.values()):
+                epoch_avg = {k: m.avg for k, m in meters.items()}
+                self.logger.info(
+                    "epoch [%03d] avg: loss=%.4f rgb_tgt=%.4f ssim_tgt=%.4f "
+                    "psnr=%.2f",
+                    epoch, epoch_avg["loss"], epoch_avg["loss_rgb_tgt"],
+                    epoch_avg["loss_ssim_tgt"], epoch_avg["psnr_tgt"],
+                )
+                self.writer.scalars(epoch_avg, global_step, prefix="train_epoch/")
 
         ckpt.save(manager, jax.device_get(state), global_step)
         ckpt.wait_until_finished(manager)

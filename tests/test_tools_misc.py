@@ -1,0 +1,106 @@
+"""Small-tool coverage: LLFF resize tool + multi-host bootstrap branches."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from mine_tpu.parallel import init_multihost
+from tools.resize_llff_images import resize_llff
+
+
+# ------------------------------------------------------------- resize tool
+
+
+def test_resize_llff(tmp_path):
+    for scene, n in [("fern", 2), ("trex", 1)]:
+        img_dir = tmp_path / scene / "images"
+        os.makedirs(img_dir)
+        for i in range(n):
+            arr = np.random.default_rng(i).integers(
+                0, 255, (63, 90, 3), np.uint8
+            )
+            Image.fromarray(arr).save(img_dir / f"{i:03d}.png")
+    (tmp_path / "not_a_scene.txt").write_text("ignored")
+
+    scenes = resize_llff(str(tmp_path), 7.875)
+    assert scenes == ["fern", "trex"]
+    out = tmp_path / "fern" / "images_7.875"
+    files = sorted(os.listdir(out))
+    assert files == ["000.png", "001.png"]
+    with Image.open(out / "000.png") as im:
+        # 63/7.875 = 8, 90/7.875 = 11.43 -> 11
+        assert (im.height, im.width) == (8, 11)
+
+    # re-run replaces the output dir (reference behavior: rmtree + remake)
+    scenes2 = resize_llff(str(tmp_path), 7.875)
+    assert scenes2 == scenes
+
+
+# ----------------------------------------------------- multi-host bootstrap
+
+
+@pytest.fixture
+def dist_calls(monkeypatch):
+    """Record jax.distributed.initialize calls; raise what the test plants."""
+    import jax
+
+    calls = {"n": 0, "kwargs": None, "raise": None}
+
+    def fake_initialize(**kwargs):
+        calls["n"] += 1
+        calls["kwargs"] = kwargs
+        if calls["raise"] is not None:
+            raise calls["raise"]
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.delenv("MINE_TPU_MULTIHOST", raising=False)
+    return calls
+
+
+def test_multihost_is_opt_in(dist_calls):
+    """No coordinator and no env flag => never touches jax.distributed
+    (auto-detection can block forever on tunneled single-chip environments)."""
+    init_multihost()
+    assert dist_calls["n"] == 0
+
+
+def test_multihost_env_flag_triggers_auto_init(dist_calls, monkeypatch):
+    monkeypatch.setenv("MINE_TPU_MULTIHOST", "1")
+    init_multihost()
+    assert dist_calls["n"] == 1 and dist_calls["kwargs"] == {}
+
+
+def test_multihost_coordinator_passed_through(dist_calls):
+    init_multihost(coordinator="10.0.0.1:1234")
+    assert dist_calls["kwargs"] == {"coordinator_address": "10.0.0.1:1234"}
+
+
+def test_multihost_already_initialized_is_quiet(dist_calls):
+    dist_calls["raise"] = RuntimeError("jax.distributed is already initialized")
+    init_multihost(coordinator="10.0.0.1:1234")  # must not raise
+
+
+def test_multihost_late_call_warns(dist_calls):
+    dist_calls["raise"] = RuntimeError(
+        "jax.distributed.initialize() must be called before any JAX computation"
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        init_multihost(coordinator="10.0.0.1:1234")
+    assert any("single-host" in str(w.message) for w in caught)
+
+
+def test_multihost_no_cluster_env_is_single_host(dist_calls, monkeypatch):
+    """Auto-detection finding no cluster (ValueError) => plain single-host."""
+    monkeypatch.setenv("MINE_TPU_MULTIHOST", "1")
+    dist_calls["raise"] = ValueError("could not find coordinator address")
+    init_multihost()  # must not raise
+
+
+def test_multihost_real_failure_with_coordinator_raises(dist_calls):
+    dist_calls["raise"] = RuntimeError("connection refused")
+    with pytest.raises(RuntimeError, match="connection refused"):
+        init_multihost(coordinator="10.0.0.1:1234")

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Offline LLFF image pre-downsampling.
+
+Reference: input_pipelines/llff/misc/resize_nerf_llff_images.py:7-28 (minus
+its hardcoded author-machine path). For every scene directory under
+--dataset-path, resizes <scene>/images/* by --ratio into
+<scene>/images_<ratio>/ — the folder naming the LLFF loader expects
+(mine_tpu/data/llff.py: `images_{img_pre_downsample_ratio}`).
+
+Usage:
+  python tools/resize_llff_images.py --dataset-path nerf_llff_data \
+      [--ratio 7.875]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+
+
+def resize_llff(dataset_path: str, ratio: float) -> list[str]:
+    """Returns the list of processed scene names."""
+    from PIL import Image
+
+    scenes = []
+    for scene in sorted(os.listdir(dataset_path)):
+        scene_dir = os.path.join(dataset_path, scene)
+        images_dir = os.path.join(scene_dir, "images")
+        if not os.path.isdir(images_dir):
+            continue
+        scenes.append(scene)
+
+        down_dir = os.path.join(scene_dir, f"images_{ratio}")
+        if os.path.exists(down_dir):
+            shutil.rmtree(down_dir)
+        os.makedirs(down_dir)
+
+        for name in sorted(os.listdir(images_dir)):
+            path = os.path.join(images_dir, name)
+            with Image.open(path) as img:
+                w_down = int(round(img.width / ratio))
+                h_down = int(round(img.height / ratio))
+                img.resize((w_down, h_down), Image.BICUBIC).save(
+                    os.path.join(down_dir, name)
+                )
+    return scenes
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dataset-path", required=True)
+    ap.add_argument(
+        "--ratio", type=float, default=7.875,
+        help="downsample ratio (reference default 7.875; matches "
+        "data.img_pre_downsample_ratio)",
+    )
+    args = ap.parse_args()
+    scenes = resize_llff(args.dataset_path, args.ratio)
+    print(f"resized {len(scenes)} scene(s): {scenes}")
+
+
+if __name__ == "__main__":
+    main()
