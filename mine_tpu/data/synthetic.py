@@ -85,6 +85,59 @@ def _sample_points(
     return (pts - cam_pos[None, :]).astype(np.float32)
 
 
+def write_colmap_scene(
+    root: str, scene: str, n_views: int = 4, hw: tuple[int, int] = (64, 64)
+) -> list[np.ndarray]:
+    """Write the analytic scene to disk in LLFF/COLMAP layout (images/ +
+    sparse/0 binary model), for fixtures and loader benchmarks. Camera i sits
+    at [0.06i, 0.02i, 0] with identity rotation; every 3D point is tracked in
+    every view. Returns the camera positions."""
+    import os
+
+    from PIL import Image
+
+    from mine_tpu.data import colmap
+
+    h, w = hw
+    k = _intrinsics(h, w)
+    scene_dir = os.path.join(root, scene)
+    os.makedirs(os.path.join(scene_dir, "sparse/0"), exist_ok=True)
+    os.makedirs(os.path.join(scene_dir, "images"), exist_ok=True)
+
+    rng = np.random.default_rng(0)
+    world_pts = _sample_points(rng, 80, np.zeros(3))  # camera-0 frame == world
+    points3d = {
+        i + 1: colmap.Point3D(i + 1, world_pts[i].astype(np.float64),
+                              np.array([255, 0, 0], np.uint8), 0.5)
+        for i in range(len(world_pts))
+    }
+
+    cameras = {1: colmap.Camera(1, "SIMPLE_RADIAL", w, h,
+                                np.array([k[0, 0], k[0, 2], k[1, 2], 0.0]))}
+    images = {}
+    positions = []
+    for i in range(n_views):
+        pos = np.array([0.06 * i, 0.02 * i, 0.0])
+        positions.append(pos)
+        img, _ = _render_view(h, w, k, pos, phase=0.3)
+        name = f"view_{i:03d}.png"
+        Image.fromarray((img * 255).astype(np.uint8)).save(
+            os.path.join(scene_dir, "images", name)
+        )
+        # G_cam_world = [I | -pos]; all points tracked in every view
+        uvw = (world_pts - pos) @ k.T
+        xys = uvw[:, :2] / uvw[:, 2:]
+        images[i + 1] = colmap.ImageMeta(
+            i + 1, np.array([1.0, 0, 0, 0]), (-pos).astype(np.float64), 1, name,
+            xys.astype(np.float64), np.arange(1, len(world_pts) + 1, dtype=np.int64),
+        )
+
+    colmap.write_cameras_binary(cameras, os.path.join(scene_dir, "sparse/0/cameras.bin"))
+    colmap.write_images_binary(images, os.path.join(scene_dir, "sparse/0/images.bin"))
+    colmap.write_points3d_binary(points3d, os.path.join(scene_dir, "sparse/0/points3D.bin"))
+    return positions
+
+
 class SyntheticDataset:
     """Procedural dataset speaking the loader protocol (steps_per_epoch +
     epoch(n) iterator of batch pytrees). Zero disk footprint; every batch is

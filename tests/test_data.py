@@ -5,54 +5,14 @@ import os
 
 import numpy as np
 import pytest
-from PIL import Image
 
 from mine_tpu.config import Config
 from mine_tpu.data import colmap
 from mine_tpu.data.llff import LLFFDataset
-from mine_tpu.data.synthetic import _intrinsics, _render_view, _sample_points
+from mine_tpu.data.synthetic import write_colmap_scene
 
-
-def _make_colmap_scene(root: str, scene: str, n_views: int = 4, hw=(64, 64)):
-    """Write a synthetic scene in LLFF/COLMAP layout: images/ + sparse/0."""
-    h, w = hw
-    k = _intrinsics(h, w)
-    scene_dir = os.path.join(root, scene)
-    os.makedirs(os.path.join(scene_dir, "sparse/0"))
-    os.makedirs(os.path.join(scene_dir, "images"))
-
-    rng = np.random.default_rng(0)
-    world_pts = _sample_points(rng, 80, np.zeros(3))  # camera-0 frame == world
-    points3d = {
-        i + 1: colmap.Point3D(i + 1, world_pts[i].astype(np.float64),
-                              np.array([255, 0, 0], np.uint8), 0.5)
-        for i in range(len(world_pts))
-    }
-
-    cameras = {1: colmap.Camera(1, "SIMPLE_RADIAL", w, h,
-                                np.array([k[0, 0], k[0, 2], k[1, 2], 0.0]))}
-    images = {}
-    positions = []
-    for i in range(n_views):
-        pos = np.array([0.06 * i, 0.02 * i, 0.0])
-        positions.append(pos)
-        img, _ = _render_view(h, w, k, pos, phase=0.3)
-        name = f"view_{i:03d}.png"
-        Image.fromarray((img * 255).astype(np.uint8)).save(
-            os.path.join(scene_dir, "images", name)
-        )
-        # G_cam_world = [I | -pos]; all points tracked in every view
-        uvw = (world_pts - pos) @ k.T
-        xys = uvw[:, :2] / uvw[:, 2:]
-        images[i + 1] = colmap.ImageMeta(
-            i + 1, np.array([1.0, 0, 0, 0]), (-pos).astype(np.float64), 1, name,
-            xys.astype(np.float64), np.arange(1, len(world_pts) + 1, dtype=np.int64),
-        )
-
-    colmap.write_cameras_binary(cameras, os.path.join(scene_dir, "sparse/0/cameras.bin"))
-    colmap.write_images_binary(images, os.path.join(scene_dir, "sparse/0/images.bin"))
-    colmap.write_points3d_binary(points3d, os.path.join(scene_dir, "sparse/0/points3D.bin"))
-    return positions
+# scene synthesis now lives in the library (shared with tools/bench_loader.py)
+_make_colmap_scene = write_colmap_scene
 
 
 def test_colmap_binary_round_trip(tmp_path):

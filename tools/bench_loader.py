@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Host input-pipeline throughput: can the loader outrun the device?
+
+The reference builds every batch synchronously inside the step loop
+(single-threaded PIL, nerf_dataset.py:199-236) and never measures it
+(SURVEY.md §5.1, §7.4.7). This tool measures, for a real on-disk LLFF scene:
+
+  1. raw production rate — imgs/sec the loader alone can emit;
+  2. delivered rate under a simulated device step time, sync (num_workers=0)
+     vs prefetched — showing whether the Trainer's background pipeline hides
+     the loader behind compute.
+
+Usage:
+  python tools/bench_loader.py --dataset-path nerf_llff_data \
+      [--img-h 384 --img-w 512] [--batch 4] [--step-ms 50] [--num-workers 4]
+  python tools/bench_loader.py --synthesize /tmp/scene [--views 24]
+
+--synthesize writes the analytic test scene in LLFF/COLMAP layout first
+(mine_tpu.data.synthetic.write_colmap_scene), so the tool runs with no data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(ds, epochs: int, simulate_step_s: float, depth: int) -> dict:
+    from mine_tpu.data import prefetch
+
+    n_imgs = 0
+    t0 = time.perf_counter()
+    for epoch in range(epochs):
+        for batch in prefetch(ds.epoch(epoch), depth):
+            n_imgs += batch["src_img"].shape[0]
+            if simulate_step_s:
+                time.sleep(simulate_step_s)  # stand-in for the device step
+    elapsed = time.perf_counter() - t0
+    return {"imgs": n_imgs, "seconds": round(elapsed, 3),
+            "imgs_per_sec": round(n_imgs / elapsed, 2)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dataset-path", help="LLFF root (scenes with sparse/0)")
+    ap.add_argument("--synthesize", metavar="DIR",
+                    help="write the analytic fixture scene here and use it")
+    ap.add_argument("--views", type=int, default=24)
+    ap.add_argument("--img-h", type=int, default=384)
+    ap.add_argument("--img-w", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--step-ms", type=float, default=50.0,
+                    help="simulated device step time for the delivered-rate runs")
+    ap.add_argument("--num-workers", type=int, default=4)
+    args = ap.parse_args()
+
+    from mine_tpu.config import Config
+    from mine_tpu.data.llff import LLFFDataset
+
+    if args.synthesize:
+        from mine_tpu.data.synthetic import write_colmap_scene
+
+        write_colmap_scene(args.synthesize, "fixture", n_views=args.views,
+                           hw=(args.img_h, args.img_w))
+        root = args.synthesize
+        ratio = 1.0
+    elif args.dataset_path:
+        root = args.dataset_path
+        ratio = None
+    else:
+        ap.error("one of --dataset-path / --synthesize is required")
+
+    overrides = {
+        "data.img_h": args.img_h, "data.img_w": args.img_w,
+        "data.training_set_path": root,
+        "data.visible_point_count": 64,
+    }
+    if ratio is not None:
+        overrides["data.img_pre_downsample_ratio"] = ratio
+    cfg = Config().replace(**overrides)
+    ds = LLFFDataset(cfg, "train", global_batch=args.batch)
+
+    raw = measure(ds, args.epochs, 0.0, depth=0)
+    step_s = args.step_ms / 1000.0
+    sync = measure(ds, args.epochs, step_s, depth=0)
+    overlapped = measure(ds, args.epochs, step_s, depth=args.num_workers)
+
+    # at perfect overlap the delivered rate is bounded by the simulated step
+    device_bound = args.batch / step_s if step_s else None
+    print(json.dumps({
+        "raw_production": raw,
+        "sync_with_step": sync,
+        "prefetched_with_step": overlapped,
+        "device_bound_imgs_per_sec": round(device_bound, 2) if device_bound else None,
+        "overlap_efficiency": (
+            round(overlapped["imgs_per_sec"] / min(raw["imgs_per_sec"], device_bound), 3)
+            if device_bound else None
+        ),
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()
