@@ -16,6 +16,9 @@ def build_dataset(cfg, split: str, global_batch: int):
     """Dataset factory (reference train.py:72-164 get_dataset)."""
     name = cfg.data.name
     if name == "synthetic":
+        # data.num_tgt_views is a no-op here by design: every synthetic batch
+        # slot is a fresh procedural scene, so "k targets per source" has no
+        # shared-source meaning (the real loaders implement it)
         from mine_tpu.data import SyntheticDataset
 
         return SyntheticDataset(

@@ -132,6 +132,40 @@ class Trainer:
             dict(self.mesh.shape), self.global_batch, steps_per_epoch,
         )
 
+        self._live_state = state  # emergency-save target from the first step on
+        try:
+            last_val = self._fit_epochs(
+                cfg, train_ds, val_ds, state, train_step, eval_step,
+                manager, meters, timer, start_step,
+            )
+        except (KeyboardInterrupt, Exception):
+            # failure containment (SURVEY.md §5.3 — the reference has none):
+            # whatever just died, persist the last completed step so the next
+            # run auto-resumes instead of losing the epoch. The emergency save
+            # itself may fail (e.g. the device poisoned the state arrays) —
+            # never let that mask the original error.
+            try:
+                host_state = jax.device_get(self._live_state)
+                step_now = int(host_state.step)
+                self.logger.exception(
+                    "training interrupted at step %d; writing emergency "
+                    "checkpoint", step_now,
+                )
+                ckpt.save(manager, host_state, step_now)
+                ckpt.wait_until_finished(manager)
+            except Exception:  # noqa: BLE001
+                self.logger.exception("emergency checkpoint failed")
+            raise
+        return last_val
+
+    def _fit_epochs(
+        self, cfg, train_ds, val_ds, state, train_step, eval_step,
+        manager, meters, timer, start_step,
+    ) -> dict[str, float]:
+        steps_per_epoch = len(train_ds)
+        global_step = start_step
+        start_epoch = start_step // steps_per_epoch + 1
+        last_val: dict[str, float] = {}
         for epoch in range(start_epoch, cfg.training.epochs + 1):
             for m in meters.values():
                 m.reset()
@@ -140,6 +174,7 @@ class Trainer:
                 if self.profile_steps and global_step == start_step + 5:
                     jax.profiler.start_trace(os.path.join(self.workspace, "profile"))
                 state, loss_dict = train_step(state, batch)
+                self._live_state = state  # for the emergency checkpoint
                 global_step += 1
                 timer.tick()
                 if self.profile_steps and global_step == start_step + 5 + self.profile_steps:
