@@ -1,0 +1,88 @@
+"""Standalone evaluation CLI: metric pass of a checkpoint over the val set.
+
+    python -m mine_tpu.evaluate --checkpoint workspace/llff_run \
+        [--extra_config '{"data.training_set_path": "..."}']
+
+The reference can only evaluate inside a training run (run_eval fires at
+eval intervals on rank 0, synthesis_task.py:496-527, :660-663); here the same
+jitted eval graph (full loss suite + PSNR/SSIM/LPIPS) runs against any
+workspace's newest checkpoint, on the whole mesh. Config comes from the
+params.yaml paired with the checkpoint, with --extra_config overrides (e.g.
+a different val path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv: list[str] | None = None) -> dict[str, float]:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--checkpoint", required=True,
+        help="training workspace dir (params.yaml + checkpoints/)",
+    )
+    parser.add_argument(
+        "--extra_config", default=None,
+        help="JSON dict of config overrides on top of the archived params.yaml",
+    )
+    args = parser.parse_args(argv)
+
+    import os
+
+    import jax
+
+    from mine_tpu.config import load_config
+    from mine_tpu.losses import load_lpips_params
+    from mine_tpu.parallel import (
+        init_multihost,
+        make_mesh,
+        make_parallel_eval_step,
+        model_axes,
+        replicate_state,
+    )
+    from mine_tpu.train import build_dataset
+    from mine_tpu.training import build_model, init_state, make_optimizer
+    from mine_tpu.training import checkpoint as ckpt
+    from mine_tpu.training.loop import run_evaluation
+    from mine_tpu.utils import MetricWriter, make_logger
+
+    init_multihost()
+    cfg = load_config(
+        os.path.join(args.checkpoint, "params.yaml"), overrides=args.extra_config
+    )
+
+    mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
+    model = build_model(cfg, **model_axes(mesh))
+    tx = make_optimizer(cfg, steps_per_epoch=1)
+    template = init_state(
+        cfg, model, tx, jax.random.PRNGKey(0), load_pretrained=False
+    )
+    manager = ckpt.checkpoint_manager(args.checkpoint)
+    state, step = ckpt.restore(manager, template)
+    if step == 0:
+        raise FileNotFoundError(
+            f"no checkpoint under {args.checkpoint}/checkpoints"
+        )
+    state = replicate_state(state, mesh)
+
+    global_batch = cfg.data.per_gpu_batch_size * mesh.shape["data"]
+    val_ds = build_dataset(cfg, "val", global_batch)
+    lpips_params = load_lpips_params(cfg.training.lpips_weights_path)
+    eval_step = make_parallel_eval_step(cfg, model, mesh, lpips_params)
+
+    logger = make_logger(args.checkpoint)
+    writer = MetricWriter(os.path.join(args.checkpoint, "eval"))
+    result = run_evaluation(
+        cfg, mesh, logger, writer, eval_step, state, val_ds, step
+    )
+    if jax.process_index() == 0:  # one JSON line, even multi-host
+        print(json.dumps(
+            {"step": step, **{k: round(v, 6) for k, v in result.items()}}
+        ))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,18 @@ LOSS_KEYS = (
 )
 
 
+def staged_batches(mesh, num_workers: int, epoch_iter: Iterable[dict]) -> Iterable[dict]:
+    """Two-stage pipeline overlap (SURVEY.md §7.4.7; the reference builds
+    every batch synchronously in the step loop, nerf_dataset.py:199-236):
+    host batches are produced up to `num_workers` ahead, but at most 2 of
+    them are device-staged (shard_batch) at a time — double-buffered H2D
+    without pinning num_workers full batches in HBM."""
+    host = prefetch(epoch_iter, max(num_workers - 2, 0))
+    return prefetch(
+        host, min(num_workers, 2), transfer=lambda b: shard_batch(mesh, b)
+    )
+
+
 class Trainer:
     """Owns mesh, model, state, and the jitted steps; `fit` runs epochs."""
 
@@ -66,16 +78,7 @@ class Trainer:
             ckpt.save_paired_config(cfg, workspace)
 
     def _staged_batches(self, epoch_iter: Iterable[dict]) -> Iterable[dict]:
-        """Two-stage pipeline overlap (SURVEY.md §7.4.7; the reference builds
-        every batch synchronously in the step loop, nerf_dataset.py:199-236):
-        host batches are produced up to data.num_workers ahead, but at most 2
-        of them are device-staged (shard_batch) at a time — double-buffered
-        H2D without pinning num_workers full batches in HBM."""
-        depth = self.cfg.data.num_workers
-        host = prefetch(epoch_iter, max(depth - 2, 0))
-        return prefetch(
-            host, min(depth, 2), transfer=lambda b: shard_batch(self.mesh, b)
-        )
+        return staged_batches(self.mesh, self.cfg.data.num_workers, epoch_iter)
 
     def fit(self, train_ds: Any, val_ds: Any | None = None) -> dict[str, float]:
         cfg = self.cfg
@@ -121,9 +124,7 @@ class Trainer:
 
         meters = {k: AverageMeter(k) for k in LOSS_KEYS}
         timer = StepTimer(self.global_batch)
-        global_step = start_step
         start_epoch = start_step // steps_per_epoch + 1
-        last_val: dict[str, float] = {}
 
         if start_step:
             self.logger.info("resumed from step %d (epoch %d)", start_step, start_epoch)
@@ -153,9 +154,11 @@ class Trainer:
                 )
                 ckpt.save(manager, host_state, step_now)
                 ckpt.wait_until_finished(manager)
-            except Exception:  # noqa: BLE001
+            except BaseException:  # noqa: BLE001 - incl. a second Ctrl+C
                 self.logger.exception("emergency checkpoint failed")
             raise
+        finally:
+            self._live_state = None  # don't pin the state in HBM after fit
         return last_val
 
     def _fit_epochs(
@@ -231,27 +234,40 @@ class Trainer:
 
     def evaluate(self, eval_step, state, val_ds: Any, global_step: int) -> dict[str, float]:
         """Full-val-set metric pass (synthesis_task.py:496-527)."""
-        meters = {k: AverageMeter(k) for k in LOSS_KEYS}
-        key = jax.random.PRNGKey(self.cfg.training.seed + 17)
-        viz = None
-        for i, batch in enumerate(self._staged_batches(val_ds.epoch(0))):
-            loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
-            for k in LOSS_KEYS:
-                meters[k].update(float(loss_dict[k]))
-        result = {k: m.avg for k, m in meters.items()}
-        self.logger.info(
-            "eval @ %d: " + " ".join(f"{k}=%.4f" for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")),
-            global_step, *[result[k] for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")],
+        return run_evaluation(
+            self.cfg, self.mesh, self.logger, self.writer,
+            eval_step, state, val_ds, global_step,
         )
-        self.writer.scalars(result, global_step, prefix="val/")
-        if viz is not None:
-            tgt = np.asarray(jax.device_get(viz["tgt_imgs_syn"]))[:4]
-            src = np.asarray(jax.device_get(viz["src_imgs_syn"]))[:4]
-            tgt_disp = normalize_disparity_for_vis(
-                np.asarray(jax.device_get(viz["tgt_disparity_syn"]))[:4]
-            )
-            self.writer.image_grid("val/tgt_syn", tgt, global_step)
-            self.writer.image_grid("val/src_syn", src, global_step)
-            self.writer.image_grid("val/tgt_disparity", tgt_disp, global_step)
-        self.writer.flush()
-        return result
+
+
+def run_evaluation(
+    cfg: Config, mesh, logger, writer, eval_step, state, val_ds: Any,
+    global_step: int,
+) -> dict[str, float]:
+    """The metric pass itself, shared by the train loop's eval intervals and
+    the standalone `python -m mine_tpu.evaluate` CLI (the reference can only
+    evaluate from inside a training job, synthesis_task.py:660-663)."""
+    meters = {k: AverageMeter(k) for k in LOSS_KEYS}
+    key = jax.random.PRNGKey(cfg.training.seed + 17)
+    viz = None
+    for i, batch in enumerate(staged_batches(mesh, cfg.data.num_workers, val_ds.epoch(0))):
+        loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
+        for k in LOSS_KEYS:
+            meters[k].update(float(loss_dict[k]))
+    result = {k: m.avg for k, m in meters.items()}
+    logger.info(
+        "eval @ %d: " + " ".join(f"{k}=%.4f" for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")),
+        global_step, *[result[k] for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")],
+    )
+    writer.scalars(result, global_step, prefix="val/")
+    if viz is not None:
+        tgt = np.asarray(jax.device_get(viz["tgt_imgs_syn"]))[:4]
+        src = np.asarray(jax.device_get(viz["src_imgs_syn"]))[:4]
+        tgt_disp = normalize_disparity_for_vis(
+            np.asarray(jax.device_get(viz["tgt_disparity_syn"]))[:4]
+        )
+        writer.image_grid("val/tgt_syn", tgt, global_step)
+        writer.image_grid("val/src_syn", src, global_step)
+        writer.image_grid("val/tgt_disparity", tgt_disp, global_step)
+    writer.flush()
+    return result

@@ -147,6 +147,40 @@ def test_emergency_checkpoint_on_failure(tmp_path):
     assert ckpt.checkpoint_manager(workspace).latest_step() == 11
 
 
+@pytest.mark.slow
+def test_evaluate_cli(tmp_path):
+    """`python -m mine_tpu.evaluate` runs the full metric pass on a
+    workspace's newest checkpoint (the reference can only evaluate from
+    inside a training job, synthesis_task.py:660-663)."""
+    import mine_tpu.evaluate as evaluate_cli
+    from mine_tpu.training import checkpoint as ckpt
+
+    cfg = TINY.replace(**{
+        "data.name": "synthetic",
+        "data.per_gpu_batch_size": 1,
+        "mpi.num_bins_coarse": 2,
+    })
+    workspace = str(tmp_path / "ws")
+    import os
+
+    os.makedirs(workspace)
+    ckpt.save_paired_config(cfg, workspace)
+    model = build_model(cfg)
+    state = init_state(cfg, model, make_optimizer(cfg, 1), jax.random.PRNGKey(0))
+    manager = ckpt.checkpoint_manager(workspace)
+    ckpt.save(manager, jax.device_get(state), 5)
+    ckpt.wait_until_finished(manager)
+
+    result = evaluate_cli.main(["--checkpoint", workspace])
+    assert np.isfinite(result["loss"]) and np.isfinite(result["psnr_tgt"])
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    ckpt.save_paired_config(cfg, empty)
+    with pytest.raises(FileNotFoundError):
+        evaluate_cli.main(["--checkpoint", empty])
+
+
 def test_loss_per_scale_use_alpha_path(rng):
     """The alpha-compositing branch (mpi.use_alpha, reference
     mpi_rendering.py:7-20) runs the full per-scale loss graph: no src-RGB
