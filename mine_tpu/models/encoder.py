@@ -17,7 +17,7 @@ is a property of the module, not a wrapper). Compute dtype is configurable
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -15,7 +15,6 @@ from __future__ import annotations
 import os
 from typing import Any
 
-import jax
 import orbax.checkpoint as ocp
 
 from mine_tpu.config import Config, load_config, save_config
