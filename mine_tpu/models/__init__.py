@@ -12,5 +12,6 @@ from mine_tpu.models.decoder import MPIDecoder, NUM_CH_DEC, nearest_up2
 from mine_tpu.models.mpi import MPINetwork, predict_mpi_coarse_to_fine
 from mine_tpu.models.pretrained import (
     apply_pretrained_backbone,
-    load_backbone_npz,
+    apply_pretrained_npz,
+    load_npz_variables,
 )

@@ -1,78 +1,107 @@
-"""Runtime loader for converted pretrained backbone weights.
+"""Runtime loader for converted pretrained weights (.npz artifacts).
 
 The reference downloads torchvision ImageNet weights at model construction,
 on rank 0 only, with no broadcast (resnet_encoder.py:56-60 — a SURVEY.md §2.4
-deadlock hazard). Here pretrained weights are an offline artifact: run
-tools/convert_resnet.py once (anywhere torch + the checkpoint live) to get an
-.npz, point `model.pretrained_backbone_path` at it, and every process loads
-identical weights before compilation — no egress, no rank asymmetry, no torch
-at runtime.
+deadlock hazard), and restores released MINE checkpoints with a tolerant
+strict=False load (utils.py:40-67) that silently skips layout mismatches.
+Here pretrained weights are an offline artifact: run tools/convert_resnet.py
+(ImageNet backbone) or tools/convert_mine_checkpoint.py (full backbone +
+decoder checkpoint) once, wherever torch and the .pth live, and every process
+loads the identical .npz before compilation — no egress, no rank asymmetry,
+no torch at runtime, and a STRICT key/shape check so weight-layout bugs fail
+loudly instead of hiding.
 
-The .npz key format is `<collection>/backbone/<module path>/<param>` (e.g.
+The .npz key format is `<collection>/<subtree>/<module path>/<param>` (e.g.
 `params/backbone/Bottleneck_3/Conv_1/kernel`,
-`batch_stats/backbone/SyncBatchNorm_0/BatchNorm_0/mean`), exactly the flax
-variable tree paths of mine_tpu.models.encoder.ResNetEncoder.
+`batch_stats/decoder/upconv_4_0/SyncBatchNorm_0/BatchNorm_0/mean`), exactly
+the flax variable tree paths of mine_tpu.models.MPINetwork.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 from flax import traverse_util
 
 _COLLECTIONS = ("params", "batch_stats")
+_SUBTREES = ("backbone", "decoder")
 
 
-def load_backbone_npz(path: str) -> dict[str, dict[str, np.ndarray]]:
-    """Read a converted .npz into {collection: {flat/backbone/path: array}}."""
+def load_npz_variables(path: str) -> dict[str, dict[str, dict[str, np.ndarray]]]:
+    """Read a converted .npz into {collection: {subtree: {flat path: arr}}}."""
     raw = np.load(path)
-    out: dict[str, dict[str, np.ndarray]] = {c: {} for c in _COLLECTIONS}
+    out: dict[str, dict[str, dict[str, np.ndarray]]] = {}
     for key in raw.files:
-        coll, sep, rest = key.partition("/")
-        if not sep or coll not in _COLLECTIONS or not rest.startswith("backbone/"):
+        parts = key.split("/", 2)
+        if len(parts) != 3 or parts[0] not in _COLLECTIONS or parts[1] not in _SUBTREES:
             raise ValueError(
-                f"{path}: unexpected key {key!r} — not a "
-                "tools/convert_resnet.py artifact?"
+                f"{path}: unexpected key {key!r} — not a tools/convert_*.py "
+                "artifact?"
             )
-        out[coll][rest[len("backbone/"):]] = raw[key]
+        coll, subtree, rest = parts
+        out.setdefault(coll, {}).setdefault(subtree, {})[rest] = raw[key]
+    return out
+
+
+def apply_pretrained_npz(
+    variables: dict[str, Any],
+    path: str,
+    expect_subtrees: Sequence[str] | None = None,
+) -> dict[str, Any]:
+    """Return `variables` with every subtree the .npz covers replaced by the
+    converted weights. Strict: for each covered subtree the .npz must match
+    the model's parameter tree exactly (no missing, no extra, no shape drift).
+
+    expect_subtrees: when given, the .npz must cover exactly these subtrees —
+    e.g. ("backbone",) for `model.pretrained_backbone_path`, so pointing it at
+    a full-checkpoint artifact (which would silently replace the decoder too)
+    is an error rather than a surprise.
+    """
+    loaded = load_npz_variables(path)
+    covered = sorted({s for colls in loaded.values() for s in colls})
+    if expect_subtrees is not None and covered != sorted(expect_subtrees):
+        raise ValueError(
+            f"{path} covers subtrees {covered}, expected "
+            f"{sorted(expect_subtrees)} — wrong converter artifact for this "
+            "config key?"
+        )
+    out = dict(variables)
+    for coll in _COLLECTIONS:
+        tree = variables.get(coll)
+        new_tree = dict(tree) if tree is not None else {}
+        for subtree in covered:
+            src = loaded.get(coll, {}).get(subtree)
+            if src is None:
+                raise ValueError(f"{path} has no {coll}/{subtree} arrays")
+            if tree is None or subtree not in tree:
+                raise ValueError(
+                    f"model variables have no {coll}/{subtree} subtree"
+                )
+            flat = traverse_util.flatten_dict(tree[subtree], sep="/")
+            missing = sorted(set(flat) - set(src))
+            extra = sorted(set(src) - set(flat))
+            if missing or extra:
+                raise ValueError(
+                    f"{path} does not match the {subtree} {coll} tree "
+                    f"(missing {len(missing)}: {missing[:4]}...; "
+                    f"extra {len(extra)}: {extra[:4]}...) — was it converted "
+                    "with the right --num-layers?"
+                )
+            bad_shapes = [
+                (k, src[k].shape, tuple(flat[k].shape))
+                for k in flat
+                if tuple(src[k].shape) != tuple(flat[k].shape)
+            ]
+            if bad_shapes:
+                raise ValueError(f"{path}: shape mismatches {bad_shapes[:4]}...")
+            new_flat = {k: jnp.asarray(src[k], flat[k].dtype) for k in flat}
+            new_tree[subtree] = traverse_util.unflatten_dict(new_flat, sep="/")
+        out[coll] = new_tree
     return out
 
 
 def apply_pretrained_backbone(variables: dict[str, Any], path: str) -> dict[str, Any]:
-    """Return `variables` with the backbone subtree replaced by the converted
-    weights at `path`. Strict: the .npz must cover the backbone's parameter
-    tree exactly (no missing, no extra, no shape drift) — the reference's
-    tolerant strict=False load (utils.py:64-67) silently skips mismatches,
-    which is how weight-layout bugs hide.
-    """
-    loaded = load_backbone_npz(path)
-    out = dict(variables)
-    for coll in _COLLECTIONS:
-        tree = variables.get(coll)
-        if tree is None or "backbone" not in tree:
-            raise ValueError(f"model variables have no {coll}/backbone subtree")
-        flat = traverse_util.flatten_dict(tree["backbone"], sep="/")
-        src = loaded[coll]
-        missing = sorted(set(flat) - set(src))
-        extra = sorted(set(src) - set(flat))
-        if missing or extra:
-            raise ValueError(
-                f"{path} does not match the backbone {coll} tree "
-                f"(missing {len(missing)}: {missing[:4]}...; "
-                f"extra {len(extra)}: {extra[:4]}...) — was it converted with "
-                "the right --num-layers?"
-            )
-        bad_shapes = [
-            (k, src[k].shape, tuple(flat[k].shape))
-            for k in flat
-            if tuple(src[k].shape) != tuple(flat[k].shape)
-        ]
-        if bad_shapes:
-            raise ValueError(f"{path}: shape mismatches {bad_shapes[:4]}...")
-        new_flat = {k: jnp.asarray(src[k], flat[k].dtype) for k in flat}
-        new_tree = dict(tree)
-        new_tree["backbone"] = traverse_util.unflatten_dict(new_flat, sep="/")
-        out[coll] = new_tree
-    return out
+    """Backbone-only replacement from a tools/convert_resnet.py artifact."""
+    return apply_pretrained_npz(variables, path, expect_subtrees=("backbone",))

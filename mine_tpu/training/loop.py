@@ -101,21 +101,38 @@ class Trainer:
         )
         # auto-resume from this workspace; else warm-start from a path
         state, start_step = ckpt.restore(manager, state)
-        if start_step == 0 and cfg.training.pretrained_checkpoint_path:
-            warm = ckpt.checkpoint_manager(cfg.training.pretrained_checkpoint_path)
-            state, warm_step = ckpt.restore(warm, state)
-            if warm_step == 0:
-                # restore() returns the template silently; a typo'd warm-start
-                # path must not degrade into training from random init
-                raise FileNotFoundError(
-                    "training.pretrained_checkpoint_path="
-                    f"{cfg.training.pretrained_checkpoint_path!r} contains no "
-                    "checkpoint"
+        warm_path = cfg.training.pretrained_checkpoint_path
+        if start_step == 0 and warm_path:
+            if warm_path.endswith(".npz"):
+                # a converted MINE torch checkpoint (backbone + decoder from
+                # tools/convert_mine_checkpoint.py): weights transfer, the
+                # optimizer/step/RNG start fresh — the reference's
+                # restore_model semantics (utils.py:40-67), strictly checked
+                from mine_tpu.models import apply_pretrained_npz
+
+                variables = apply_pretrained_npz(
+                    {"params": state.params, "batch_stats": state.batch_stats},
+                    warm_path, expect_subtrees=("backbone", "decoder"),
                 )
-            self.logger.info(
-                "warm-started from %s @ step %d",
-                cfg.training.pretrained_checkpoint_path, warm_step,
-            )
+                state = state.replace(
+                    params=variables["params"],
+                    batch_stats=variables["batch_stats"],
+                )
+                self.logger.info("warm-started from converted %s", warm_path)
+            else:
+                warm = ckpt.checkpoint_manager(warm_path)
+                state, warm_step = ckpt.restore(warm, state)
+                if warm_step == 0:
+                    # restore() returns the template silently; a typo'd
+                    # warm-start path must not degrade into training from
+                    # random init
+                    raise FileNotFoundError(
+                        "training.pretrained_checkpoint_path="
+                        f"{warm_path!r} contains no checkpoint"
+                    )
+                self.logger.info(
+                    "warm-started from %s @ step %d", warm_path, warm_step
+                )
         state = replicate_state(state, self.mesh)
 
         lpips_params = load_lpips_params(cfg.training.lpips_weights_path)

@@ -55,14 +55,19 @@ def build_model(
     cfg: Config,
     axis_name: str | None = None,
     plane_axis: str | None = None,
+    scales: tuple[int, ...] = (0, 1, 2, 3),
 ) -> MPINetwork:
     """axis_name: data-replica BN sync axis; plane_axis: the S-plane mesh
-    axis under plane sharding (use parallel.model_axes(mesh) to derive both)."""
+    axis under plane sharding (use parallel.model_axes(mesh) to derive both).
+    scales: which pyramid levels get output heads AND loss terms — the loss
+    graph (loss_fcn) follows model.scales, so a reduced tuple shrinks the
+    whole compiled step (used by the multichip dryrun; 0 must be included)."""
     return MPINetwork(
         num_layers=cfg.model.num_layers,
         multires=cfg.model.pos_encoding_multires,
         use_alpha=cfg.mpi.use_alpha,
         sigma_dropout_rate=cfg.mpi.sigma_dropout_rate,
+        scales=scales,
         axis_name=axis_name,
         plane_axis=plane_axis,
         dtype=jnp.bfloat16 if cfg.model.dtype == "bfloat16" else jnp.float32,
@@ -390,9 +395,11 @@ def loss_fcn(
         plane_axis=plane_axis,
     )
 
+    scales = sorted(model.scales)
+    assert scales and scales[0] == 0, "scale 0 drives calibration + viz"
     scale_factor = None
     loss_dicts, viz_dicts = [], []
-    for scale in range(4):
+    for scale in scales:
         ld, vz, scale_factor = loss_fcn_per_scale(
             cfg, scale, batch, mpis[scale], disparity, scale_factor,
             is_val=is_val, lpips_params=lpips_params, compositor=compositor,
@@ -402,8 +409,7 @@ def loss_fcn(
 
     loss_dict = dict(loss_dicts[0])
     total = loss_dict["loss"]
-    for scale in range(1, 4):
-        ld = loss_dicts[scale]
+    for ld in loss_dicts[1:]:
         if cfg.training.use_multi_scale:
             total = total + ld["loss_rgb_tgt"] + ld["loss_ssim_tgt"]
         total = total + ld["loss_disp_pt3dsrc"] + ld["loss_disp_pt3dtgt"]

@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""Offline converter: a full MINE torch checkpoint -> .npz flax variables.
+
+The reference saves checkpoints as {"backbone": state_dict, "decoder":
+state_dict[, "optimizer": ...]} (synthesis_task.py:649-651) and restores them
+with a tolerant strict=False load (utils.py:40-67). This tool maps BOTH
+networks' weights onto this framework's flax variable tree in one .npz, so a
+released MINE checkpoint can drive training warm-starts
+(`training.pretrained_checkpoint_path: ckpt.npz`) or inference — with a
+STRICT key/shape check at load time instead of the reference's silent skips.
+
+Usage:
+  python tools/convert_mine_checkpoint.py --checkpoint checkpoint_latest.pth \
+      --num-layers 50 --out mine_llff.npz
+
+Backbone mapping is tools/convert_resnet.py's. Decoder mapping
+(reference: network/monodepth2/depth_decoder.py:56-86, layers.py:106-138):
+  conv_down1/conv_down2/conv_up1/conv_up2 (Conv2d no-bias + BN + LeakyReLU)
+      -> ConvBNLeaky_0..3/{Conv_0, SyncBatchNorm_0}
+  convs[("upconv", i, j)] (Conv3x3 reflect-pad w/ bias + BN + ELU)
+      -> upconv_{i}_{j}/{Conv3x3_0/Conv_0, SyncBatchNorm_0}
+  convs[("dispconv", s)] (Conv3x3 w/ bias) -> dispconv_{s}/Conv_0
+  conv weights OIHW -> HWIO; BN weight/bias -> params scale/bias,
+  running_mean/var -> batch_stats mean/var.
+The torch ModuleDict keys come from the reference's `tuple_to_str`
+('-'.join over str(tuple) — a per-character join) and are reproduced here
+verbatim rather than assumed readable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from convert_resnet import torch_resnet_to_flax  # noqa: E402
+
+_EXTENSION = ("conv_down1", "conv_down2", "conv_up1", "conv_up2")
+
+
+def _tuple_to_str(key_tuple: tuple) -> str:
+    """The reference's ModuleDict key codec (depth_decoder.py:36-38)."""
+    return "-".join(str(key_tuple))
+
+
+def torch_decoder_to_flax(state_dict: dict) -> dict[str, np.ndarray]:
+    """Map the reference DepthDecoder state_dict to flat flax .npz keys.
+
+    Raises KeyError on missing torch keys and ValueError on leftover unmapped
+    keys, so a non-MINE checkpoint fails loudly.
+    """
+    sd = {k: np.asarray(getattr(v, "numpy", lambda: v)()) for k, v in state_dict.items()}
+    out: dict[str, np.ndarray] = {}
+    used: set[str] = set()
+
+    def conv(dst: str, w_key: str, b_key: str | None) -> None:
+        w = sd[w_key]  # (O, I, kh, kw)
+        out[f"params/decoder/{dst}/kernel"] = np.transpose(w, (2, 3, 1, 0)).astype(np.float32)
+        used.add(w_key)
+        if b_key is not None:
+            out[f"params/decoder/{dst}/bias"] = sd[b_key].astype(np.float32)
+            used.add(b_key)
+
+    def bn(dst: str, src: str) -> None:
+        out[f"params/decoder/{dst}/BatchNorm_0/scale"] = sd[f"{src}.weight"].astype(np.float32)
+        out[f"params/decoder/{dst}/BatchNorm_0/bias"] = sd[f"{src}.bias"].astype(np.float32)
+        out[f"batch_stats/decoder/{dst}/BatchNorm_0/mean"] = sd[f"{src}.running_mean"].astype(np.float32)
+        out[f"batch_stats/decoder/{dst}/BatchNorm_0/var"] = sd[f"{src}.running_var"].astype(np.float32)
+        used.update(f"{src}.{p}" for p in ("weight", "bias", "running_mean", "running_var"))
+        used.add(f"{src}.num_batches_tracked")
+
+    for k, name in enumerate(_EXTENSION):
+        conv(f"ConvBNLeaky_{k}/Conv_0", f"{name}.0.weight", None)
+        bn(f"ConvBNLeaky_{k}/SyncBatchNorm_0", f"{name}.1")
+
+    for i in range(5):
+        for j in (0, 1):
+            pre = f"convs.{_tuple_to_str(('upconv', i, j))}"
+            conv(f"upconv_{i}_{j}/Conv3x3_0/Conv_0",
+                 f"{pre}.conv.conv.weight", f"{pre}.conv.conv.bias")
+            bn(f"upconv_{i}_{j}/SyncBatchNorm_0", f"{pre}.bn")
+
+    for s in range(4):
+        pre = f"convs.{_tuple_to_str(('dispconv', s))}"
+        if f"{pre}.conv.weight" in sd:  # heads exist per decoder `scales`
+            conv(f"dispconv_{s}/Conv_0", f"{pre}.conv.weight", f"{pre}.conv.bias")
+
+    leftover = sorted(set(sd) - used)
+    if leftover:
+        raise ValueError(
+            f"unmapped torch decoder keys (not a MINE DepthDecoder "
+            f"checkpoint?): {leftover[:6]}..."
+        )
+    return out
+
+
+def torch_mine_checkpoint_to_flax(
+    checkpoint: dict, num_layers: int
+) -> dict[str, np.ndarray]:
+    """{"backbone": sd, "decoder": sd, ...} -> one flat flax .npz dict.
+
+    Strips DDP "module." prefixes the way the reference restore does
+    (utils.py:53-54); ignores any "optimizer" entry (torch Adam moments do
+    not transfer to optax)."""
+    out: dict[str, np.ndarray] = {}
+    for key, to_flax in (("backbone", None), ("decoder", torch_decoder_to_flax)):
+        if key not in checkpoint:
+            raise KeyError(
+                f"checkpoint has no {key!r} entry (keys: {sorted(checkpoint)}) "
+                "— not a MINE training checkpoint?"
+            )
+        sd = {
+            (k[len("module."):] if k.startswith("module.") else k): v
+            for k, v in checkpoint[key].items()
+        }
+        if to_flax is None:
+            out.update(torch_resnet_to_flax(sd, num_layers))
+        else:
+            out.update(to_flax(sd))
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--checkpoint", required=True,
+                    help="MINE .pth checkpoint ({'backbone','decoder',...})")
+    ap.add_argument("--num-layers", type=int, default=50,
+                    choices=(18, 34, 50, 101, 152))
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import torch
+
+    ckpt = torch.load(args.checkpoint, map_location="cpu", weights_only=True)
+    arrays = torch_mine_checkpoint_to_flax(ckpt, args.num_layers)
+    np.savez(args.out, **arrays)
+    print(f"wrote {args.out}: {len(arrays)} arrays (backbone + decoder)")
+
+
+if __name__ == "__main__":
+    main()
