@@ -1,0 +1,6 @@
+"""Hand-written Mosaic/Pallas TPU kernels for ops XLA lowers poorly.
+
+Currently: the bilinear warp behind ops.grid_sample (the per-plane
+homography-warp workhorse, reference hot-op #2 — SURVEY.md §3.1)."""
+
+from mine_tpu.ops.pallas.warp import warp_bilinear_chw

@@ -1,0 +1,358 @@
+"""Pallas TPU kernel for bilinear image warping (the grid_sample hot op).
+
+Why this exists: XLA lowers the 4-corner gather of a bilinear sampler to a
+generic TPU gather that runs ~100x slower than memory bound (measured 2.9 s
+for one (64, 384, 512, 7) warp on v5e — the entire train step's budget many
+times over; reference hot-op ranking SURVEY.md §3.1). TPU vector hardware has
+no general 2-D gather, but Mosaic DOES support `take_along_axis` along the
+128-lane axis within a native (8, 128) tile. This kernel restructures the
+warp around that primitive:
+
+  * the whole source image (C, H, W) sits in VMEM (≤ ~6 MB for the shapes
+    this model uses — checked at dispatch);
+  * each program instance produces one (8, 128) output tile for all C
+    channels;
+  * the source pixels needed by an output tile lie in the projective image
+    of that tile — a small axis-aligned bounding box of source (8, 128)
+    tiles, computed in-kernel from the coord block (the warps are smooth;
+    for near-identity homographies the box is 1-4 tiles);
+  * for each source tile in the box, each of the 4 bilinear corners is
+    fetched with 8 broadcast-row lane-gathers + sublane selects, masked by
+    tile membership, and accumulated.
+
+The public entry keeps the exact border-padding semantics of
+ops.grid_sample.grid_sample_pixel (torch grid_sample parity,
+homography_sampler.py:143-148): coordinates clamp to [0, size-1] and the
+corner pair is (floor(min(x, size-2)), +1), which is value-identical to the
+clamp-both-corners form for every in-range x.
+
+The backward pass is a kernel too (`warp_bilinear_grad_chw`): the source
+cotangent is a scatter — XLA's TPU scatter is as pathological as its gather —
+reformulated per visited source tile as 8 one-hot MXU contractions
+(sublane-row masking x lane one-hot matmul), accumulated into a full-image
+VMEM block across the output-tile grid. Coordinate cotangents are elementwise
+given the 4 corner values, so the forward variant `warp_bilinear_fwd_chw`
+saves them as residuals. Mosaic restrictions shaped all of this: in-tile
+`take_along_axis` only at native (8, 128) tiles, no nested dynamic-bound
+loops, no scalar div/mod by traced values, tile-aligned dynamic slice starts.
+
+Not used on CPU (Mosaic is TPU-only); tests run interpret mode on tiny shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+from jax.experimental import pallas as pl
+
+TILE_H = 8
+TILE_W = 128
+
+
+def _corner_gather(tile: Array, ly: Array, lx: Array, acc: Array) -> Array:
+    """Accumulate tile[ly, lx] where (ly, lx) lands inside this (8, 128) tile.
+
+    tile: (TILE_H, TILE_W) one channel of one source tile.
+    ly/lx: (TILE_H, TILE_W) int32 tile-local corner coords (any value; only
+    in-range entries are used). acc: running (TILE_H, TILE_W) accumulator.
+    """
+    valid = (ly >= 0) & (ly < TILE_H) & (lx >= 0) & (lx < TILE_W)
+    lxc = jnp.clip(lx, 0, TILE_W - 1)
+    got = jnp.zeros_like(acc)
+    for s in range(TILE_H):
+        row = jnp.broadcast_to(tile[s][None, :], (TILE_H, TILE_W))
+        g = jnp.take_along_axis(row, lxc, axis=1)
+        got = jnp.where(ly == s, g, got)
+    return jnp.where(valid, got, acc)
+
+
+def _prep_coords(x_ref, y_ref, h: int, w: int):
+    """Shared coordinate munging: border clamp, corner split, row-tile bbox.
+
+    Returns (wx, wy, x0, y0, r0, r1). The bbox covers the source ROW tiles
+    the 4 corners can touch (y1 = y0+1), clamped to the real tile range: the
+    coord block's padding lanes (edge output tiles) carry whatever was in
+    memory and must not widen the box or poison the visit count. Columns are
+    walked statically — Mosaic cannot lower nested dynamic-bound loops (nor
+    scalar div/mod by a traced count), and there are at most w/128 = 4
+    column tiles.
+    """
+    x = jnp.clip(x_ref[0], 0.0, w - 1.0)
+    y = jnp.clip(y_ref[0], 0.0, h - 1.0)
+    x0f = jnp.floor(jnp.minimum(x, w - 2.0))
+    y0f = jnp.floor(jnp.minimum(y, h - 2.0))
+    wx = x - x0f
+    wy = y - y0f
+    x0 = x0f.astype(jnp.int32)
+    y0 = y0f.astype(jnp.int32)
+    max_r = (h - 1) // TILE_H
+    r0 = jnp.clip(jnp.min(y0) // TILE_H, 0, max_r)
+    r1 = jnp.clip((jnp.max(y0) + 1) // TILE_H, r0, max_r)
+    return wx, wy, x0, y0, r0, r1
+
+
+def _warp_kernel(x_ref, y_ref, src_ref, out_ref, *corner_refs,
+                 h: int, w: int, c: int):
+    """One (8, 128) output tile, all channels.
+
+    x_ref/y_ref: (1, TILE_H, TILE_W) source-pixel coords for this tile.
+    src_ref: (1, c, hp, wp) the full source image, padded up to whole
+    (TILE_H, TILE_W) tiles; h/w are the LOGICAL dims all coordinate clamping
+    uses (the padding is never sampled). out_ref: (1, c, TILE_H, TILE_W).
+    corner_refs: optionally a (1, 4, c, TILE_H, TILE_W) ref that receives the
+    raw corner values (a00, a01, a10, a11) — the residuals the coordinate
+    cotangent needs.
+    """
+    wp = src_ref.shape[3]
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
+
+    def visit(carry, r, cc):
+        """Accumulate all 4 corners x all channels from source tile (r, cc).
+        The padded dims guarantee aligned, in-bounds tile slices."""
+        start_r = pl.multiple_of(r * TILE_H, TILE_H)
+        start_c = pl.multiple_of(cc * TILE_W, TILE_W)
+        ly0 = y0 - start_r
+        lx0 = x0 - start_c
+        out = []
+        for ch in range(c):
+            tile = src_ref[0, ch, pl.ds(start_r, TILE_H),
+                           pl.ds(start_c, TILE_W)]
+            a00, a01, a10, a11 = carry[ch]
+            a00 = _corner_gather(tile, ly0, lx0, a00)
+            a01 = _corner_gather(tile, ly0, lx0 + 1, a01)
+            a10 = _corner_gather(tile, ly0 + 1, lx0, a10)
+            a11 = _corner_gather(tile, ly0 + 1, lx0 + 1, a11)
+            out.append((a00, a01, a10, a11))
+        return out
+
+    zero = jnp.zeros((TILE_H, TILE_W), src_ref.dtype)
+    carry = [(zero, zero, zero, zero) for _ in range(c)]
+
+    n_col_tiles = max((wp + TILE_W - 1) // TILE_W, 1)
+
+    def row_body(r, carry):
+        for cc in range(n_col_tiles):  # static unroll; masked visits no-op
+            carry = visit(carry, r, cc)
+        return carry
+
+    carry = lax.fori_loop(r0, r1 + 1, row_body, carry)
+
+    wxc = wx.astype(src_ref.dtype)
+    wyc = wy.astype(src_ref.dtype)
+    for ch in range(c):
+        a00, a01, a10, a11 = carry[ch]
+        top = a00 * (1.0 - wxc) + a01 * wxc
+        bot = a10 * (1.0 - wxc) + a11 * wxc
+        out_ref[0, ch] = top * (1.0 - wyc) + bot * wyc
+        if corner_refs:
+            for k, a in enumerate((a00, a01, a10, a11)):
+                corner_refs[0][0, k, ch] = a
+
+
+def _scatter_tile(vals: Array, ly: Array, lx: Array) -> Array:
+    """Within-tile scatter-add: out[ch, s, x] = sum over output pixels (i, j)
+    of vals[ch, i, j] * [ly[i, j] == s] * [lx[i, j] == x].
+
+    vals: (C, TILE_H, TILE_W) over OUTPUT pixels, out-of-tile entries
+    pre-masked to 0. ly/lx: (TILE_H, TILE_W). Returns the (C, TILE_H, TILE_W)
+    source-tile contribution.
+
+    MXU formulation chosen for Mosaic's layout rules: for each output row i,
+    both one-hot factors are built in their NATURAL layout (no transposes,
+    no cross-tile reshapes) and contracted over their shared LANE axis j —
+    an "NT" matmul:  A[c*8+s, j] = vals[c, i, j]*[ly[i,j]==s]  (sublanes
+    stack channels*rows),  Xoh[x, j] = [lx[i,j]==x],  P = A @ Xoh^T ->
+    (c*8, x). Channels ride the same matmul, so each of the 8 output rows
+    costs one (8C, 128) x (128, 128) MXU pass. Precision.HIGHEST keeps the
+    value factor fp32-exact (the one-hot factor is exact in any precision).
+    """
+    c = vals.shape[0]
+    sub8 = lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 0)
+    subw = lax.broadcasted_iota(jnp.int32, (TILE_W, TILE_W), 0)
+    contrib = jnp.zeros((c, TILE_H, TILE_W), vals.dtype)
+    for i in range(TILE_H):
+        ly_i = ly[i : i + 1, :]  # (1, TILE_W) along lanes
+        lx_i = lx[i : i + 1, :]
+        xoh = (subw == lx_i).astype(vals.dtype)  # (x, j)
+        rows = [
+            jnp.where(sub8 == ly_i, vals[ch, i : i + 1, :], 0.0)  # (s, j)
+            for ch in range(c)
+        ]
+        lhs = jnp.concatenate(rows, axis=0)  # (c*8, j)
+        p = lax.dot_general(
+            lhs, xoh, (((1,), (1,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=vals.dtype,
+        )  # (c*8, x)
+        contrib = contrib + p.reshape(c, TILE_H, TILE_W)
+    return contrib
+
+
+def _warp_grad_kernel(x_ref, y_ref, g_ref, gsrc_ref, *,
+                      h: int, w: int, c: int, ho: int, wo: int):
+    """Source cotangent for one (8, 128) output tile, all channels.
+
+    g_ref: (1, c, TILE_H, TILE_W) output cotangent. gsrc_ref: the FULL
+    (1, c, hp, wp) source-gradient image, zeroed on this image's first tile
+    and accumulated across the whole output-tile grid (sequential on TPU).
+    ho/wo: LOGICAL output dims — edge tiles' padding lanes hold arbitrary
+    memory in both the coord and cotangent blocks and must not scatter.
+    """
+    wp = gsrc_ref.shape[3]
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _zero():
+        gsrc_ref[...] = jnp.zeros(gsrc_ref.shape, gsrc_ref.dtype)
+
+    in_image = (
+        (i * TILE_H + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 0) < ho)
+        & (j * TILE_W + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 1) < wo)
+    )
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
+    corner_weights = (
+        (0, 0, (1.0 - wx) * (1.0 - wy)),
+        (0, 1, wx * (1.0 - wy)),
+        (1, 0, (1.0 - wx) * wy),
+        (1, 1, wx * wy),
+    )
+    n_col_tiles = max((wp + TILE_W - 1) // TILE_W, 1)
+
+    def visit(_, r, cc):
+        start_r = pl.multiple_of(r * TILE_H, TILE_H)
+        start_c = pl.multiple_of(cc * TILE_W, TILE_W)
+        ly0 = y0 - start_r
+        lx0 = x0 - start_c
+        # whole visit is side-effect-only, so empty column tiles (the warp's
+        # footprint is a narrow box; columns are walked statically) skip all
+        # MXU work under pl.when
+        touches = jnp.any(
+            (ly0 >= -1) & (ly0 <= TILE_H) & (lx0 >= -1) & (lx0 <= TILE_W)
+        )
+
+        @pl.when(touches)
+        def _do_visit():
+            for dy, dx, wgt in corner_weights:
+                ly = ly0 + dy
+                lx = lx0 + dx
+                valid = in_image & (ly >= 0) & (ly < TILE_H) \
+                    & (lx >= 0) & (lx < TILE_W)
+                lyc = jnp.clip(ly, 0, TILE_H - 1)
+                lxc = jnp.clip(lx, 0, TILE_W - 1)
+                vals = jnp.where(
+                    valid[None], g_ref[0] * wgt[None], 0.0
+                )  # (c, TILE_H, TILE_W)
+                contrib = _scatter_tile(vals, lyc, lxc)
+                for ch in range(c):
+                    sl = (0, ch, pl.ds(start_r, TILE_H), pl.ds(start_c, TILE_W))
+                    gsrc_ref[sl] = gsrc_ref[sl] + contrib[ch]
+        return 0
+
+    def row_body(r, carry):
+        for cc in range(n_col_tiles):  # static unroll; masked visits no-op
+            carry = visit(carry, r, cc)
+        return carry
+
+    lax.fori_loop(r0, r1 + 1, row_body, 0)
+
+
+def _pad_tiles(src: Array) -> Array:
+    """Pad (N, C, H, W) up to whole (TILE_H, TILE_W) tiles: in-kernel dynamic
+    slice starts must stay tile-aligned (Mosaic rejects unaligned lane-dim
+    starts) and at least one full tile must exist. The padding is never
+    sampled — coords clamp to the logical h/w."""
+    h, w = src.shape[2], src.shape[3]
+    pad_h = (-h) % TILE_H if h >= TILE_H else TILE_H - h
+    pad_w = (-w) % TILE_W if w >= TILE_W else TILE_W - w
+    if pad_h or pad_w:
+        src = jnp.pad(src, ((0, 0), (0, 0), (0, pad_h), (0, pad_w)))
+    return src
+
+
+def _coord_specs():
+    return [
+        pl.BlockSpec((1, TILE_H, TILE_W), lambda ni, i, j: (ni, i, j)),
+        pl.BlockSpec((1, TILE_H, TILE_W), lambda ni, i, j: (ni, i, j)),
+    ]
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct carrying the union of the operands' varying mesh
+    axes: under shard_map's strict vma checking, pallas_call outputs must
+    declare how they vary across the mesh (they vary exactly as much as the
+    inputs do — the kernel is pointwise in the mesh)."""
+    vma = frozenset()
+    for op in operands:
+        vma |= getattr(jax.typeof(op), "vma", frozenset()) or frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def warp_bilinear_chw(src: Array, coords_x: Array, coords_y: Array,
+                      interpret: bool = False,
+                      save_corners: bool = False):
+    """Bilinear border-padded sampling, channels-major.
+
+    src: (N, C, H, W); coords_x/coords_y: (N, Ho, Wo) source-pixel coords.
+    Returns (N, C, Ho, Wo) (same dtype as src) — plus, with save_corners,
+    the raw corner values (N, 4, C, Ho, Wo) ordered (a00, a01, a10, a11).
+    """
+    n, c, h, w = src.shape
+    _, ho, wo = coords_x.shape
+    src = _pad_tiles(src)
+    hp, wp = src.shape[2], src.shape[3]
+    grid = (n, pl.cdiv(ho, TILE_H), pl.cdiv(wo, TILE_W))
+    kernel = functools.partial(_warp_kernel, h=h, w=w, c=c)
+    out_shape = [_out_struct((n, c, ho, wo), src.dtype, src, coords_x, coords_y)]
+    out_specs = [
+        pl.BlockSpec((1, c, TILE_H, TILE_W), lambda ni, i, j: (ni, 0, i, j))
+    ]
+    if save_corners:
+        out_shape.append(
+            _out_struct((n, 4, c, ho, wo), src.dtype, src, coords_x, coords_y)
+        )
+        out_specs.append(pl.BlockSpec(
+            (1, 4, c, TILE_H, TILE_W), lambda ni, i, j: (ni, 0, 0, i, j)
+        ))
+    result = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=_coord_specs() + [
+            # full image, revisited across (i, j) — refetched only when n moves
+            pl.BlockSpec((1, c, hp, wp), lambda ni, i, j: (ni, 0, 0, 0)),
+        ],
+        out_specs=out_specs if save_corners else out_specs[0],
+        out_shape=out_shape if save_corners else out_shape[0],
+        interpret=interpret,
+    )(coords_x, coords_y, src)
+    return result
+
+
+def warp_bilinear_grad_chw(coords_x: Array, coords_y: Array, g: Array,
+                           h: int, w: int,
+                           interpret: bool = False) -> Array:
+    """Source cotangent of warp_bilinear_chw: scatters the output cotangent
+    g (N, C, Ho, Wo) back through the bilinear footprint into (N, C, h, w).
+    """
+    n, c, ho, wo = g.shape
+    hp = h + ((-h) % TILE_H if h >= TILE_H else TILE_H - h)
+    wp = w + ((-w) % TILE_W if w >= TILE_W else TILE_W - w)
+    grid = (n, pl.cdiv(ho, TILE_H), pl.cdiv(wo, TILE_W))
+    kernel = functools.partial(_warp_grad_kernel, h=h, w=w, c=c, ho=ho, wo=wo)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=_coord_specs() + [
+            pl.BlockSpec((1, c, TILE_H, TILE_W), lambda ni, i, j: (ni, 0, i, j)),
+        ],
+        # the full gradient image accumulates across this image's (i, j) steps
+        out_specs=pl.BlockSpec((1, c, hp, wp), lambda ni, i, j: (ni, 0, 0, 0)),
+        out_shape=_out_struct((n, c, hp, wp), g.dtype, g, coords_x, coords_y),
+        interpret=interpret,
+    )(coords_x, coords_y, g)
+    return out[:, :, :h, :w]
