@@ -33,8 +33,8 @@ def scene(rng):
     return src, coords, g
 
 
-def test_forward_parity(scene):
-    src, coords, _ = scene
+def assert_forward_parity(src, coords, rtol=1e-5, atol=1e-5, err_msg=""):
+    """Kernel (interpret mode) vs the XLA path on NHWC inputs."""
     want = np.asarray(gs._grid_sample_xla(jnp.asarray(src), jnp.asarray(coords)))
     out = warp_bilinear_chw(
         jnp.asarray(np.moveaxis(src, -1, 1)),
@@ -42,8 +42,14 @@ def test_forward_parity(scene):
         interpret=True,
     )
     np.testing.assert_allclose(
-        np.moveaxis(np.asarray(out), 1, -1), want, rtol=1e-5, atol=1e-5
+        np.moveaxis(np.asarray(out), 1, -1), want,
+        rtol=rtol, atol=atol, err_msg=err_msg,
     )
+
+
+def test_forward_parity(scene):
+    src, coords, _ = scene
+    assert_forward_parity(src, coords)
 
 
 def test_corner_residuals_recompose(scene):
@@ -100,6 +106,41 @@ def test_custom_vjp_end_to_end(scene, monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got_coords), np.asarray(want_coords), rtol=1e-4, atol=1e-4
     )
+
+
+@pytest.mark.parametrize(
+    "h,w,lo,hi,note",
+    [
+        (16, 64, -3, 70, "sub-tile W (pad path, scale-3 shape class)"),
+        (16, 200, 0, 199, "non-multiple W with full-range coords"),
+        (24, 136, 60, 62, "degenerate bbox (all coords in one tile)"),
+    ],
+)
+def test_forward_parity_edge_shapes(rng, h, w, lo, hi, note):
+    src = rng.uniform(size=(1, h, w, 2)).astype(np.float32)
+    coords = rng.uniform(lo, hi, size=(1, 16, 132, 2)).astype(np.float32)
+    assert_forward_parity(src, coords, err_msg=note)
+
+
+def test_integer_and_border_coords(rng):
+    """Exact grid hits and exact border coords: wx/wy hit 0/1 exactly and the
+    corner-pair convention must still match the XLA path bit-for-bit."""
+    h, w = 16, 128
+    src = rng.uniform(size=(1, h, w, 1)).astype(np.float32)
+    xs = np.array([0.0, 1.0, w - 2.0, w - 1.0, w / 2], np.float32)
+    ys = np.array([0.0, 1.0, h - 2.0, h - 1.0, h / 2], np.float32)
+    gx, gy = np.meshgrid(xs, ys)
+    coords = np.stack([gx, gy], -1)[None].astype(np.float32)
+    assert_forward_parity(src, coords, rtol=0, atol=0)
+
+
+def test_vmem_guard():
+    """Oversized sources must fall back to the XLA path instead of handing
+    Mosaic an unallocatable VMEM block."""
+    small = jnp.zeros((1, 384, 512, 8), jnp.float32)
+    big = jnp.zeros((1, 756, 1008, 8), jnp.float32)  # full-res LLFF eval
+    assert gs._fits_vmem(small)
+    assert not gs._fits_vmem(big)
 
 
 def test_dispatch_uses_xla_off_tpu(scene):
