@@ -166,8 +166,13 @@ def _scatter_tile(vals: Array, ly: Array, lx: Array) -> Array:
     an "NT" matmul:  A[c*8+s, j] = vals[c, i, j]*[ly[i,j]==s]  (sublanes
     stack channels*rows),  Xoh[x, j] = [lx[i,j]==x],  P = A @ Xoh^T ->
     (c*8, x). Channels ride the same matmul, so each of the 8 output rows
-    costs one (8C, 128) x (128, 128) MXU pass. Precision.HIGHEST keeps the
-    value factor fp32-exact (the one-hot factor is exact in any precision).
+    costs one (8C, 128) x (128, 128) MXU pass.
+
+    Precision: a hand-rolled two-term bf16 split of the value factor (hi +
+    residual; the one-hot factor is exact in bf16, and bf16 products
+    accumulate in fp32 on the MXU) — ~3e-6 relative error, 10x faster than
+    Precision.HIGHEST's 6-pass algorithm on these shapes (Mosaic does not
+    support the 3-pass HIGH).
     """
     c = vals.shape[0]
     sub8 = lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 0)
@@ -176,17 +181,17 @@ def _scatter_tile(vals: Array, ly: Array, lx: Array) -> Array:
     for i in range(TILE_H):
         ly_i = ly[i : i + 1, :]  # (1, TILE_W) along lanes
         lx_i = lx[i : i + 1, :]
-        xoh = (subw == lx_i).astype(vals.dtype)  # (x, j)
+        xoh = (subw == lx_i).astype(jnp.bfloat16)  # (x, j)
         rows = [
             jnp.where(sub8 == ly_i, vals[ch, i : i + 1, :], 0.0)  # (s, j)
             for ch in range(c)
         ]
         lhs = jnp.concatenate(rows, axis=0)  # (c*8, j)
-        p = lax.dot_general(
-            lhs, xoh, (((1,), (1,)), ((), ())),
-            precision=lax.Precision.HIGHEST,
-            preferred_element_type=vals.dtype,
-        )  # (c*8, x)
+        hi = lhs.astype(jnp.bfloat16)
+        lo = (lhs - hi.astype(lhs.dtype)).astype(jnp.bfloat16)
+        nt = (((1,), (1,)), ((), ()))
+        p = lax.dot_general(hi, xoh, nt, preferred_element_type=vals.dtype)
+        p = p + lax.dot_general(lo, xoh, nt, preferred_element_type=vals.dtype)
         contrib = contrib + p.reshape(c, TILE_H, TILE_W)
     return contrib
 

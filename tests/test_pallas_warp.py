@@ -77,9 +77,11 @@ def test_src_cotangent_parity(scene):
         jnp.asarray(coords[..., 0]), jnp.asarray(coords[..., 1]),
         jnp.asarray(np.moveaxis(g, -1, 1)), H, W, interpret=True,
     )
+    # atol 1e-4: the scatter kernel's two-term bf16 split carries ~3e-6 of
+    # the accumulated scale (see _scatter_tile), not fp32 exactness
     np.testing.assert_allclose(
         np.moveaxis(np.asarray(got), 1, -1), np.asarray(want_src),
-        rtol=1e-4, atol=1e-5,
+        rtol=1e-4, atol=1e-4,
     )
 
 
@@ -101,7 +103,7 @@ def test_custom_vjp_end_to_end(scene, monkeypatch):
         rtol=1e-5, atol=1e-5,
     )
     np.testing.assert_allclose(
-        np.asarray(got_src), np.asarray(want_src), rtol=1e-4, atol=1e-5
+        np.asarray(got_src), np.asarray(want_src), rtol=1e-4, atol=1e-4
     )
     np.testing.assert_allclose(
         np.asarray(got_coords), np.asarray(want_coords), rtol=1e-4, atol=1e-4
