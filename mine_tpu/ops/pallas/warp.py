@@ -188,11 +188,13 @@ def _scatter_tile(vals: Array, ly: Array, lx: Array) -> Array:
         ]
         lhs = jnp.concatenate(rows, axis=0)  # (c*8, j)
         hi = lhs.astype(jnp.bfloat16)
-        lo = (lhs - hi.astype(lhs.dtype)).astype(jnp.bfloat16)
         nt = (((1,), (1,)), ((), ()))
-        p = lax.dot_general(hi, xoh, nt, preferred_element_type=vals.dtype)
-        p = p + lax.dot_general(lo, xoh, nt, preferred_element_type=vals.dtype)
-        contrib = contrib + p.reshape(c, TILE_H, TILE_W)
+        p = lax.dot_general(hi, xoh, nt, preferred_element_type=jnp.float32)
+        if lhs.dtype != jnp.bfloat16:  # for bf16 payloads lo is exactly 0
+            lo = (lhs - hi.astype(lhs.dtype)).astype(jnp.bfloat16)
+            p = p + lax.dot_general(lo, xoh, nt,
+                                    preferred_element_type=jnp.float32)
+        contrib = contrib + p.astype(vals.dtype).reshape(c, TILE_H, TILE_W)
     return contrib
 
 

@@ -136,6 +136,31 @@ def test_integer_and_border_coords(rng):
     assert_forward_parity(src, coords, rtol=0, atol=0)
 
 
+def test_out_struct_vma_propagation():
+    """Under shard_map's strict vma checking the kernel's out_shapes must
+    declare the union of the inputs' varying mesh axes (the parallel train
+    step runs the kernel inside shard_map on TPU; round-3 regression — the
+    compile failed with 'vma must not be None'). Pinned at the helper level
+    because pallas interpret mode cannot itself run under check_vma."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mine_tpu.ops.pallas.warp import _out_struct
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+    captured = {}
+
+    def f(x):
+        s = _out_struct((4,), jnp.float32, x, jnp.float32(1.0))
+        captured["vma"] = getattr(s, "vma", None)
+        return x
+
+    shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))(
+        jnp.zeros((2, 3), jnp.float32)
+    )
+    assert captured["vma"] == frozenset({"data"})
+
+
 def test_vmem_guard():
     """Oversized sources must fall back to the XLA path instead of handing
     Mosaic an unallocatable VMEM block."""
