@@ -16,9 +16,9 @@ warp around that primitive:
     of that tile — a small axis-aligned bounding box of source (8, 128)
     tiles, computed in-kernel from the coord block (the warps are smooth;
     for near-identity homographies the box is 1-4 tiles);
-  * for each source tile in the box, each of the 4 bilinear corners is
-    fetched with 8 broadcast-row lane-gathers + sublane selects, masked by
-    tile membership, and accumulated.
+  * for each source tile in the box, the 4 bilinear corners are fetched
+    with 8 broadcast-row passes (two lane-gathers each, shared across the
+    corner pairs) + sublane selects, masked by tile membership, accumulated.
 
 The public entry keeps the exact border-padding semantics of
 ops.grid_sample.grid_sample_pixel (torch grid_sample parity,
@@ -52,21 +52,43 @@ TILE_H = 8
 TILE_W = 128
 
 
-def _corner_gather(tile: Array, ly: Array, lx: Array, acc: Array) -> Array:
-    """Accumulate tile[ly, lx] where (ly, lx) lands inside this (8, 128) tile.
+def _corner_gather4(tile: Array, ly0: Array, lx0: Array, accs) -> tuple:
+    """Accumulate all 4 bilinear corners (y0/y0+1 x x0/x0+1) of one source
+    tile into the per-corner accumulators, sharing each source row's
+    broadcast and its two lane-gathers (x0, x0+1) across the corner pairs.
 
     tile: (TILE_H, TILE_W) one channel of one source tile.
-    ly/lx: (TILE_H, TILE_W) int32 tile-local corner coords (any value; only
-    in-range entries are used). acc: running (TILE_H, TILE_W) accumulator.
+    ly0/lx0: (TILE_H, TILE_W) int32 tile-local top-left corner coords (any
+    value; only in-range entries are used). accs: 4 running accumulators
+    ordered (a00, a01, a10, a11).
     """
-    valid = (ly >= 0) & (ly < TILE_H) & (lx >= 0) & (lx < TILE_W)
-    lxc = jnp.clip(lx, 0, TILE_W - 1)
-    got = jnp.zeros_like(acc)
+    a00, a01, a10, a11 = accs
+    ly1 = ly0 + 1
+    lx1 = lx0 + 1
+    lxc0 = jnp.clip(lx0, 0, TILE_W - 1)
+    lxc1 = jnp.clip(lx1, 0, TILE_W - 1)
+    z = jnp.zeros_like(a00)
+    g00 = g01 = g10 = g11 = z
     for s in range(TILE_H):
         row = jnp.broadcast_to(tile[s][None, :], (TILE_H, TILE_W))
-        g = jnp.take_along_axis(row, lxc, axis=1)
-        got = jnp.where(ly == s, g, got)
-    return jnp.where(valid, got, acc)
+        t0 = jnp.take_along_axis(row, lxc0, axis=1)
+        t1 = jnp.take_along_axis(row, lxc1, axis=1)
+        on0 = ly0 == s
+        on1 = ly1 == s
+        g00 = jnp.where(on0, t0, g00)
+        g01 = jnp.where(on0, t1, g01)
+        g10 = jnp.where(on1, t0, g10)
+        g11 = jnp.where(on1, t1, g11)
+    y0_in = (ly0 >= 0) & (ly0 < TILE_H)
+    y1_in = (ly1 >= 0) & (ly1 < TILE_H)
+    x0_in = (lx0 >= 0) & (lx0 < TILE_W)
+    x1_in = (lx1 >= 0) & (lx1 < TILE_W)
+    return (
+        jnp.where(y0_in & x0_in, g00, a00),
+        jnp.where(y0_in & x1_in, g01, a01),
+        jnp.where(y1_in & x0_in, g10, a10),
+        jnp.where(y1_in & x1_in, g11, a11),
+    )
 
 
 def _prep_coords(x_ref, y_ref, h: int, w: int):
@@ -120,12 +142,7 @@ def _warp_kernel(x_ref, y_ref, src_ref, out_ref, *corner_refs,
         for ch in range(c):
             tile = src_ref[0, ch, pl.ds(start_r, TILE_H),
                            pl.ds(start_c, TILE_W)]
-            a00, a01, a10, a11 = carry[ch]
-            a00 = _corner_gather(tile, ly0, lx0, a00)
-            a01 = _corner_gather(tile, ly0, lx0 + 1, a01)
-            a10 = _corner_gather(tile, ly0 + 1, lx0, a10)
-            a11 = _corner_gather(tile, ly0 + 1, lx0 + 1, a11)
-            out.append((a00, a01, a10, a11))
+            out.append(_corner_gather4(tile, ly0, lx0, carry[ch]))
         return out
 
     zero = jnp.zeros((TILE_H, TILE_W), src_ref.dtype)
@@ -221,6 +238,10 @@ def _warp_grad_kernel(x_ref, y_ref, g_ref, gsrc_ref, *,
         & (j * TILE_W + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 1) < wo)
     )
     wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
+    # weights in the cotangent's dtype so bf16 cotangents stay bf16 all the
+    # way to the store (and _scatter_tile's single-matmul bf16 path engages)
+    wx = wx.astype(g_ref.dtype)
+    wy = wy.astype(g_ref.dtype)
     corner_weights = (
         (0, 0, (1.0 - wx) * (1.0 - wy)),
         (0, 1, wx * (1.0 - wy)),
@@ -253,7 +274,7 @@ def _warp_grad_kernel(x_ref, y_ref, g_ref, gsrc_ref, *,
                 vals = jnp.where(
                     valid[None], g_ref[0] * wgt[None], 0.0
                 )  # (c, TILE_H, TILE_W)
-                contrib = _scatter_tile(vals, lyc, lxc)
+                contrib = _scatter_tile(vals, lyc, lxc).astype(gsrc_ref.dtype)
                 for ch in range(c):
                     sl = (0, ch, pl.ds(start_r, TILE_H), pl.ds(start_c, TILE_W))
                     gsrc_ref[sl] = gsrc_ref[sl] + contrib[ch]

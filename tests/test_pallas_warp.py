@@ -136,6 +136,24 @@ def test_integer_and_border_coords(rng):
     assert_forward_parity(src, coords, rtol=0, atol=0)
 
 
+def test_bf16_cotangent_runs(scene):
+    """A bf16 cotangent must flow through the grad kernel (bf16 weights,
+    bf16 store) and land within bf16 tolerance of the f32 result."""
+    src, coords, g = scene
+    got16 = warp_bilinear_grad_chw(
+        jnp.asarray(coords[..., 0]), jnp.asarray(coords[..., 1]),
+        jnp.asarray(np.moveaxis(g, -1, 1), jnp.bfloat16), H, W, interpret=True,
+    )
+    assert got16.dtype == jnp.bfloat16
+    want = warp_bilinear_grad_chw(
+        jnp.asarray(coords[..., 0]), jnp.asarray(coords[..., 1]),
+        jnp.asarray(np.moveaxis(g, -1, 1)), H, W, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got16, np.float32), np.asarray(want), rtol=0.1, atol=0.1
+    )
+
+
 def test_out_struct_vma_propagation():
     """Under shard_map's strict vma checking the kernel's out_shapes must
     declare the union of the inputs' varying mesh axes (the parallel train
