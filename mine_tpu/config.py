@@ -98,6 +98,13 @@ class TrainingConfig:
     epochs: int = 15
     eval_interval: int = 10000
     pretrained_checkpoint_path: str = ""
+    # which variable subtrees an .npz warm start must cover ("backbone",
+    # "decoder"). The default demands a full converted checkpoint; set e.g.
+    # ("backbone",) to warm-start from a backbone-only artifact — the escape
+    # hatch for legitimately partial checkpoints that the reference handles
+    # via blanket strict=False loading (utils.py:40-67), kept explicit here
+    # so a wrong artifact still fails loudly
+    pretrained_subtrees: tuple[str, ...] = ("backbone", "decoder")
     src_rgb_blending: bool = True
     use_multi_scale: bool = True
     seed: int = 0
@@ -176,7 +183,12 @@ def _coerce(value: Any, target_type: Any, key: str) -> Any:
     if isinstance(target_type, str) and target_type.startswith("tuple"):
         if isinstance(value, str):
             value = [v for v in value.replace(" ", "").split(",") if v]
-        elem = float if "float" in target_type else int
+        if "float" in target_type:
+            elem = float
+        elif "str" in target_type:
+            elem = str
+        else:
+            elem = int
         return tuple(elem(v) for v in value)
     return value
 

@@ -170,6 +170,14 @@ class LLFFDataset:
             )
         if not self.images:
             raise FileNotFoundError(f"no posed images under {root!r} ({folder})")
+        if not is_val and len(self.images) < global_batch // self.num_tgt_views:
+            # with drop_last a too-small train set would yield ZERO batches
+            # per epoch — a silent no-op training run; fail loudly instead
+            raise ValueError(
+                f"train split has {len(self.images)} source image(s) but one "
+                f"global batch needs {global_batch // self.num_tgt_views}; "
+                "every epoch would be empty (reduce the batch or add data)"
+            )
         # scene -> global indices (nerf_dataset.py scene_to_indices)
         self.scene_indices: dict[str, list[int]] = {}
         for i, im in enumerate(self.images):
@@ -182,7 +190,15 @@ class LLFFDataset:
                 )
 
     def __len__(self) -> int:
-        return max(len(self.images) // (self.global_batch // self.num_tgt_views), 1)
+        n_src = self.global_batch // self.num_tgt_views
+        if self.is_val:
+            # val covers EVERY image (reference run_eval iterates the full
+            # val DataLoader, drop_last=False — synthesis_task.py:506-515);
+            # the final short batch is wrap-padded to keep shapes static
+            return -(-len(self.images) // n_src)
+        # train drops the short tail (reference DataLoader drop_last=True,
+        # train.py:110); __len__ must agree with what epoch() yields
+        return len(self.images) // n_src
 
     def _examples(self, src_idx: int, rng: np.random.Generator) -> list[dict[str, np.ndarray]]:
         """num_tgt_views (src, tgt) pairs for one source view."""
@@ -223,8 +239,17 @@ class LLFFDataset:
         n_src = self.global_batch // self.num_tgt_views
         for start in range(0, len(self) * n_src, n_src):
             idxs = order[start : start + n_src]
-            if len(idxs) < n_src:  # drop_last
-                break
+            if len(idxs) < n_src:
+                if not self.is_val:  # drop_last, like the reference's train
+                    break            # DataLoader (train.py:110, drop_last=True)
+                # Val: wrap-pad the tail from the start of the order so every
+                # image is evaluated under one static batch shape (XLA: no
+                # ragged batches; a short batch would force a recompile and
+                # break even sharding across the data mesh axis). The few
+                # wrapped examples are re-evaluated — a slight over-weighting
+                # in the epoch average, vs the reference's skipping them
+                # entirely before round 4.
+                idxs = np.concatenate([idxs, np.resize(order, n_src - len(idxs))])
             examples = [e for i in idxs for e in self._examples(int(i), rng)]
             yield {
                 k: np.stack([e[k] for e in examples]) for k in examples[0]

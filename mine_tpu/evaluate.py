@@ -33,7 +33,6 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
 
     import jax
 
-    from mine_tpu.config import load_config
     from mine_tpu.losses import load_lpips_params
     from mine_tpu.parallel import (
         init_multihost,
@@ -49,9 +48,10 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
     from mine_tpu.utils import MetricWriter, make_logger
 
     init_multihost()
-    cfg = load_config(
-        os.path.join(args.checkpoint, "params.yaml"), overrides=args.extra_config
-    )
+    # resolves through local_sidecar_dir, so a remote (gs://) workspace finds
+    # the params.yaml its training run archived locally
+    cfg = ckpt.load_paired_config(args.checkpoint, overrides=args.extra_config)
+    sidecar = ckpt.local_sidecar_dir(args.checkpoint)
 
     mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
     model = build_model(cfg, **model_axes(mesh))
@@ -72,8 +72,8 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
     lpips_params = load_lpips_params(cfg.training.lpips_weights_path)
     eval_step = make_parallel_eval_step(cfg, model, mesh, lpips_params)
 
-    logger = make_logger(args.checkpoint)
-    writer = MetricWriter(os.path.join(args.checkpoint, "eval"))
+    logger = make_logger(sidecar)
+    writer = MetricWriter(os.path.join(sidecar, "eval"))
     result = run_evaluation(
         cfg, mesh, logger, writer, eval_step, state, val_ds, step
     )

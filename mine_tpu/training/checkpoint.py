@@ -12,6 +12,7 @@ image_to_video.py:275-277).
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Any
 
@@ -22,6 +23,47 @@ from mine_tpu.config import Config, load_config, save_config
 _LATEST_EVERY = "state"  # item name inside each step directory
 
 
+def checkpoint_path(workspace: str) -> str:
+    """<workspace>/checkpoints, preserving URL schemes.
+
+    `os.path.abspath` would mangle `gs://bucket/run` into an absolute local
+    path, silently blocking remote durability — so URL-scheme workspaces
+    (anything with `://`) pass through verbatim and only local paths are
+    absolutized (orbax requires absolute local directories)."""
+    if "://" in workspace:
+        return workspace.rstrip("/") + "/checkpoints"
+    return os.path.abspath(os.path.join(workspace, "checkpoints"))
+
+
+def local_sidecar_dir(workspace: str) -> str:
+    """Local directory for the workspace's non-checkpoint artifacts
+    (params.yaml, logs, tensorboard events, profiler traces).
+
+    For an ordinary local workspace this IS the workspace. For a URL-scheme
+    workspace (`gs://bucket/run`) those writers use plain open()/makedirs and
+    cannot target object storage — without this mapping they would create a
+    literal local `gs:/…` directory. They land in a per-run directory under a
+    STABLE root instead — $MINE_TPU_RUNS_DIR or ~/.cache/mine_tpu/runs, never
+    the process CWD, so a resume launched from a different directory finds
+    the same logs/params.yaml — keyed by the full URL including its scheme
+    (`gs://x/y` and `s3://x/y` must not collide). Checkpoints alone go remote
+    via orbax; the reference likewise keeps sidecars local between periodic
+    HDFS pushes (synthesis_task.py:654-679).
+    """
+    if "://" not in workspace:
+        return workspace
+    url = workspace.rstrip("/")
+    # readable prefix + URL hash: flattening '://' and '/' to '_' alone would
+    # collide distinct workspaces (gs://b/my_run vs gs://b/my/run)
+    digest = hashlib.sha1(url.encode()).hexdigest()[:10]
+    sanitized = url.replace("://", "_").replace("/", "_")
+    root = os.environ.get(
+        "MINE_TPU_RUNS_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "mine_tpu", "runs"),
+    )
+    return os.path.abspath(os.path.join(root, f"{sanitized}-{digest}"))
+
+
 def checkpoint_manager(
     workspace: str, max_to_keep: int = 3, keep_period: int | None = None
 ) -> ocp.CheckpointManager:
@@ -30,8 +72,14 @@ def checkpoint_manager(
     max_to_keep bounds the rolling 'latest' set (reference keeps one rolling
     checkpoint_latest.pth); keep_period pins every k-th step forever (the
     reference's immutable checkpoint_%012d at eval intervals).
+
+    A URL-scheme workspace (`gs://bucket/run`, `file://…`) passes through
+    un-mangled, so orbax writes checkpoints durably to object storage — the
+    analog of the reference's HDFS upload (synthesis_task.py:654-658,
+    utils.py:20-37 `run_shell_cmd` hadoop put), minus the rank-0 shell-out:
+    orbax coordinates the multi-host write itself.
     """
-    path = os.path.abspath(os.path.join(workspace, "checkpoints"))
+    path = checkpoint_path(workspace)
     options = ocp.CheckpointManagerOptions(
         max_to_keep=max_to_keep,
         keep_period=keep_period,
@@ -59,9 +107,21 @@ def save_paired_config(cfg: Config, workspace: str) -> None:
     save_config(cfg, os.path.join(workspace, "params.yaml"))
 
 
-def load_paired_config(workspace: str) -> Config:
-    """Inference re-reads the archived config (image_to_video.py:275-277)."""
-    return load_config(os.path.join(workspace, "params.yaml"))
+def load_paired_config(workspace: str, overrides: str | None = None) -> Config:
+    """Inference re-reads the archived config (image_to_video.py:275-277).
+
+    Resolves through local_sidecar_dir, so a remote (`gs://…`) workspace
+    finds the params.yaml its training run archived locally — the same
+    mapping save_paired_config wrote through (identity for local paths)."""
+    path = os.path.join(local_sidecar_dir(workspace), "params.yaml")
+    if not os.path.isfile(path) and "://" in workspace:
+        raise FileNotFoundError(
+            f"{path} not found. Workspace {workspace!r} is remote: its "
+            "checkpoints live in object storage, but params.yaml is a local "
+            "sidecar of the machine that trained (see local_sidecar_dir). "
+            "Copy that file here or set MINE_TPU_RUNS_DIR to its root."
+        )
+    return load_config(path, overrides=overrides)
 
 
 def wait_until_finished(manager: ocp.CheckpointManager) -> None:

@@ -67,15 +67,25 @@ class Trainer:
         init_multihost()
         self.cfg = cfg
         self.workspace = workspace
+        # URL-scheme workspaces (gs://…) are valid for checkpoints (orbax
+        # writes them remotely); params.yaml / logs / TB events / profiler
+        # traces use plain file IO and land in a derived local dir instead
+        self.local_dir = ckpt.local_sidecar_dir(workspace)
         self.profile_steps = profile_steps
         self.mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
-        self.logger = make_logger(workspace)
-        self.writer = MetricWriter(workspace)
+        self.logger = make_logger(self.local_dir)
+        self.writer = MetricWriter(self.local_dir)
         self.model = build_model(cfg, **model_axes(self.mesh))
         self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
         if jax.process_index() == 0:
-            os.makedirs(workspace, exist_ok=True)
-            ckpt.save_paired_config(cfg, workspace)
+            os.makedirs(self.local_dir, exist_ok=True)
+            ckpt.save_paired_config(cfg, self.local_dir)
+            if self.local_dir != workspace:
+                self.logger.info(
+                    "workspace %s is remote: checkpoints go there via orbax; "
+                    "params.yaml/logs/tensorboard/profiles go to %s",
+                    workspace, self.local_dir,
+                )
 
     def _staged_batches(self, epoch_iter: Iterable[dict]) -> Iterable[dict]:
         return staged_batches(self.mesh, self.cfg.data.num_workers, epoch_iter)
@@ -110,9 +120,14 @@ class Trainer:
                 # restore_model semantics (utils.py:40-67), strictly checked
                 from mine_tpu.models import apply_pretrained_npz
 
+                # training.pretrained_subtrees defaults to the full
+                # (backbone, decoder) checkpoint; ("backbone",) accepts a
+                # backbone-only artifact (partial-restore escape hatch —
+                # the strict analog of the reference's strict=False load)
                 variables = apply_pretrained_npz(
                     {"params": state.params, "batch_stats": state.batch_stats},
-                    warm_path, expect_subtrees=("backbone", "decoder"),
+                    warm_path,
+                    expect_subtrees=cfg.training.pretrained_subtrees,
                 )
                 state = state.replace(
                     params=variables["params"],
@@ -192,7 +207,7 @@ class Trainer:
             batches = self._staged_batches(train_ds.epoch(epoch))
             for step_in_epoch, batch in enumerate(batches, start=1):
                 if self.profile_steps and global_step == start_step + 5:
-                    jax.profiler.start_trace(os.path.join(self.workspace, "profile"))
+                    jax.profiler.start_trace(os.path.join(self.local_dir, "profile"))
                 state, loss_dict = train_step(state, batch)
                 self._live_state = state  # for the emergency checkpoint
                 global_step += 1
@@ -200,7 +215,7 @@ class Trainer:
                 if self.profile_steps and global_step == start_step + 5 + self.profile_steps:
                     jax.block_until_ready(loss_dict["loss"])
                     jax.profiler.stop_trace()
-                    self.logger.info("profile trace written to %s/profile", self.workspace)
+                    self.logger.info("profile trace written to %s/profile", self.local_dir)
 
                 if step_in_epoch % cfg.training.log_interval == 0:
                     host_losses = {k: float(loss_dict[k]) for k in LOSS_KEYS}

@@ -127,6 +127,34 @@ def test_llff_val_targets_deterministic(llff_root):
     np.testing.assert_array_equal(t1[0]["tgt_img"], t2[0]["tgt_img"])
 
 
+def test_llff_val_covers_every_image(tmp_path):
+    """Val eval must see EVERY image (reference run_eval iterates the full
+    val set, drop_last=False — synthesis_task.py:506-515). With 5 images and
+    batch 2, the tail batch is wrap-padded rather than dropped, so all 5
+    sources appear and every batch keeps the static shape."""
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=5)
+    scene = os.path.join(tmp_path, "scene_a")
+    os.rename(os.path.join(scene, "images"), os.path.join(scene, "images_val"))
+    ds = LLFFDataset(_llff_cfg(str(tmp_path)), "val", global_batch=2)
+    assert len(ds) == 3  # ceil(5 / 2)
+    batches = list(ds.epoch(0))
+    assert len(batches) == 3
+    assert all(b["src_img"].shape == (2, 64, 64, 3) for b in batches)
+    srcs = np.concatenate([b["src_img"] for b in batches])
+    uniq = {srcs[i].tobytes() for i in range(len(srcs))}
+    assert len(uniq) == 5  # every val image evaluated as a source
+
+    # train split still drops the short tail (reference train DataLoader
+    # drop_last=True)
+    train_scene = os.path.join(tmp_path, "scene_a")
+    os.rename(
+        os.path.join(train_scene, "images_val"),
+        os.path.join(train_scene, "images"),
+    )
+    tr = LLFFDataset(_llff_cfg(str(tmp_path)), "train", global_batch=2)
+    assert len(tr) == 2 and len(list(tr.epoch(0))) == 2
+
+
 def test_llff_warp_consistency(llff_root):
     """End-to-end geometry: warping the src view's far plane into the target
     camera with the dataset's own K/G reproduces the target view where the

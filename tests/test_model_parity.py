@@ -53,10 +53,25 @@ def ref_decoder_cls():
         sys.path.remove(REFERENCE_ROOT)
 
 
+class _RefResnetEncoder(torch.nn.Module):
+    """Mirrors the reference ResnetEncoder's key layout: the torchvision net
+    is nested under `self.encoder` (resnet_encoder.py:86), so genuine MINE
+    checkpoints store 'encoder.conv1.weight' etc. Saving through this wrapper
+    makes the synthetic checkpoint match that layout and exercises the
+    converter's encoder-prefix stripping."""
+
+    def __init__(self, net: torch.nn.Module):
+        super().__init__()
+        self.encoder = net
+
+    def forward(self, x):
+        return self.encoder(x)
+
+
 def _torch_mine_pair(ref_decoder_cls, seed: int = 3):
     """A randomly-initialized reference (backbone, decoder) pair in eval mode."""
     DepthDecoder, get_embedder = ref_decoder_cls
-    backbone = _TorchPyramid(NUM_LAYERS).eval()
+    backbone = _RefResnetEncoder(_TorchPyramid(NUM_LAYERS)).eval()
     embedder, e_dim = get_embedder(MULTIRES)
     decoder = DepthDecoder(
         num_ch_enc=np.array([64, 64, 128, 256, 512]),
@@ -136,7 +151,7 @@ def test_backbone_npz_rejected_where_full_checkpoint_expected(
 
     backbone, _ = _torch_mine_pair(ref_decoder_cls)
     p = str(tmp_path / "backbone_only.npz")
-    np.savez(p, **torch_resnet_to_flax(backbone.state_dict(), NUM_LAYERS))
+    np.savez(p, **torch_resnet_to_flax(backbone.encoder.state_dict(), NUM_LAYERS))
     model = MPINetwork(num_layers=NUM_LAYERS, multires=MULTIRES, dtype=jnp.float32)
     variables = model.init(
         jax.random.PRNGKey(0),

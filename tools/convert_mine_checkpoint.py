@@ -118,6 +118,15 @@ def torch_mine_checkpoint_to_flax(
             for k, v in checkpoint[key].items()
         }
         if to_flax is None:
+            # The reference's backbone is ResnetEncoder, which nests the
+            # torchvision net under `self.encoder` (resnet_encoder.py:86), so
+            # real checkpoints store 'encoder.conv1.weight' etc. Strip that
+            # wrapper prefix (after DDP 'module.') down to the bare
+            # torchvision layout convert_resnet.py expects.
+            sd = {
+                (k[len("encoder."):] if k.startswith("encoder.") else k): v
+                for k, v in sd.items()
+            }
             out.update(torch_resnet_to_flax(sd, num_layers))
         else:
             out.update(to_flax(sd))
