@@ -109,7 +109,15 @@ def main() -> None:
     run_ok.set()
 
 
-def _run() -> None:
+# set as soon as the primary measurement exists: a hang/failure in the
+# OPTIONAL second point must degrade to reporting the primary result (with a
+# late_error field), never to discarding a valid measurement
+_RESULT_SO_FAR: dict | None = None
+
+
+def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
+    """One (compile, warm, time) cycle of the full train step at a given
+    per-device batch size. Returns imgs/sec + XLA-cost-analysis MFU fields."""
     import jax
     import jax.numpy as jnp
 
@@ -121,7 +129,7 @@ def _run() -> None:
         cfg = Config().replace(**{
             "data.name": "llff",
             "data.img_h": 384, "data.img_w": 512,
-            "data.per_gpu_batch_size": BATCH,
+            "data.per_gpu_batch_size": batch_size,
             "mpi.num_bins_coarse": 32,
             "loss.smoothness_gmin": 0.8,
             "loss.smoothness_grad_ratio": 0.2,
@@ -133,7 +141,7 @@ def _run() -> None:
         step = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
         return state, step
 
-    batch_np = make_synthetic_batch(BATCH, 384, 512, n_points=256, seed=0)
+    batch_np = make_synthetic_batch(batch_size, 384, 512, n_points=256, seed=0)
     batch_np.pop("src_depth")
     batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
 
@@ -154,16 +162,28 @@ def _run() -> None:
         force(state, loss_dict)
         return compiled, state, loss_dict
 
+    remat_used = False
     state, step = build(remat=False)
     try:
         compiled, state, loss_dict = compile_and_warm(state, step)
     except Exception as e:  # noqa: BLE001 - HBM OOM => retry with remat
         if "RESOURCE_EXHAUSTED" not in str(e).upper().replace(" ", "_"):
             raise
-        print(f"# OOM without remat, retrying with remat_decoder ({e})",
-              file=sys.stderr)
+        print(f"# OOM at B={batch_size} without remat, retrying with "
+              f"remat_decoder ({e})", file=sys.stderr)
+        remat_used = True
         state, step = build(remat=True)
         compiled, state, loss_dict = compile_and_warm(state, step)
+
+    if profile_dir:
+        # capture a trace of the real steady-state step for the MFU accounting
+        # (BASELINE.md cost table); 3 steps is enough for the op breakdown
+        jax.profiler.start_trace(profile_dir)
+        for _ in range(3):
+            state, loss_dict = compiled(state, batch)
+        force(state, loss_dict)
+        jax.profiler.stop_trace()
+        print(f"# profile trace written to {profile_dir}", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
@@ -171,7 +191,7 @@ def _run() -> None:
     force(state, loss_dict)
     elapsed = time.perf_counter() - t0
 
-    imgs_per_sec = BATCH * MEASURE_STEPS / elapsed
+    imgs_per_sec = batch_size * MEASURE_STEPS / elapsed
     flops_per_step = executable_flops(compiled)
     device = jax.devices()[0]
     peak = chip_peak_flops(device.device_kind)
@@ -182,30 +202,77 @@ def _run() -> None:
         round(model_flops_per_sec / peak, 4)
         if model_flops_per_sec and peak else None
     )
-    print(json.dumps({
-        "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
+    return {
         "value": round(imgs_per_sec, 3),
-        "unit": "imgs/sec",
-        "vs_baseline": None,
         "flops_per_step": flops_per_step,
         "model_tflops_per_sec": (
             round(model_flops_per_sec / 1e12, 3) if model_flops_per_sec else None
         ),
         "mfu": mfu,
+        "step_ms": round(elapsed / MEASURE_STEPS * 1e3, 1),
+        "remat": remat_used,
         "device": device.device_kind,
+    }
+
+
+def _run() -> None:
+    global _RESULT_SO_FAR
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
+    primary = _measure_point(BATCH, profile_dir=profile_dir)
+
+    result = {
+        "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
+        "value": primary["value"],
+        "unit": "imgs/sec",
+        "vs_baseline": None,
+        "flops_per_step": primary["flops_per_step"],
+        "model_tflops_per_sec": primary["model_tflops_per_sec"],
+        "mfu": primary["mfu"],
+        "step_ms": primary["step_ms"],
+        "device": primary["device"],
         "note": (
             "vs_baseline awaits a measured reference denominator (the "
             "reference repo publishes no throughput, SURVEY.md §6); mfu = "
-            "XLA cost-analysis FLOPs / published chip peak"
+            "XLA cost-analysis FLOPs / published chip peak; B=2 is the "
+            "reference recipe's per-GPU batch (params_llff.yaml), not a "
+            "TPU constraint — see the b8 fields for the hardware-friendly "
+            "point"
         ),
-    }))
+    }
+
+    _RESULT_SO_FAR = result
+
+    # second point at per-device batch 8: B=2 is recipe parity, not a TPU
+    # limit; larger batches amortize small-conv overheads on the MXU.
+    # Opt out with BENCH_SECOND_POINT=0 (e.g. when the tunnel is flaky and
+    # one compile is all the budget allows).
+    if os.environ.get("BENCH_SECOND_POINT", "1") != "0":
+        try:
+            b8 = _measure_point(8)
+            result["value_b8"] = b8["value"]
+            result["mfu_b8"] = b8["mfu"]
+            result["step_ms_b8"] = b8["step_ms"]
+            result["flops_per_step_b8"] = b8["flops_per_step"]
+            result["remat_b8"] = b8["remat"]
+        except Exception as e:  # noqa: BLE001 - the primary number stands alone
+            print(f"# B=8 point failed: {e}", file=sys.stderr)
+            result["b8_error"] = f"{type(e).__name__}: {e}"[:500]
+
+    print(json.dumps(result))
 
 
 def _emit_failure(exc: BaseException) -> None:
     """Always leave the driver one parseable JSON line, even when the TPU
     backend never comes up (the axon tunnel is mortal: round 3's bench died
-    with a bare stack trace and the driver recorded `parsed: null`)."""
+    with a bare stack trace and the driver recorded `parsed: null`).
+
+    If the primary measurement already succeeded (the failure came from the
+    optional B=8 point or later), emit THAT result with a late_error field —
+    a valid number must never be discarded."""
     msg = f"{type(exc).__name__}: {exc}"
+    if _RESULT_SO_FAR is not None:
+        print(json.dumps({**_RESULT_SO_FAR, "late_error": msg[:2000]}))
+        return
     print(json.dumps({
         "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
         "value": None,

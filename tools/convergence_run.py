@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Convergence run: train the synthetic scene family to quality and measure
+novel-view PSNR against analytic ground truth.
+
+The first trained-to-quality evidence for the framework (VERDICT r3 weak #4):
+everything before this checked only that loss decreases for a few steps. Here
+the full train step (4-scale loss, BN statistics, LR schedule, calibration)
+runs for hundreds-to-thousands of steps on procedurally generated scenes
+(`data/synthetic.py` — every batch a fresh texture phase), then the model is
+asked to do the real task on a HELD-OUT scene: predict an MPI from one source
+image and render NOVEL camera poses (none equal to the fixed training
+baseline), scored in PSNR against the analytic renderer, which evaluates any
+pose exactly. Reference analog: the reference's quality evidence is full LLFF
+training (synthesis_task.py:496-527 run_eval); its recipe needs GPUs-days and
+a dataset download, neither of which this environment has — the analytic
+scene gives held-out ground truth for free.
+
+Usage:
+  python tools/convergence_run.py --steps 800 --eval-every 100 \
+      --out workspace/convergence
+Writes <out>/curve.jsonl ({"step", "loss", "psnr_novel", ...} per eval) and
+prints a final JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# camera offsets for eval; the training baseline is fixed at 0.08 along +x
+# (make_synthetic_batch), so none of these equals a trained pose
+NOVEL_OFFSETS = np.array([
+    [0.03, 0.0, 0.0],
+    [0.06, 0.02, 0.0],
+    [-0.04, 0.01, 0.0],
+])
+CROP = 16  # interior crop: border band is clamp-padding, not scene content
+
+
+def build_cfg(height: int, width: int, batch: int, num_planes: int, steps: int):
+    from mine_tpu.config import Config
+
+    return Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": height, "data.img_w": width,
+        "data.per_gpu_batch_size": batch,
+        "model.num_layers": 18,
+        "model.dtype": "float32",  # CPU path; bf16 is a TPU-bench concern
+        "mpi.num_bins_coarse": num_planes,
+        # bracket the scene's depth range (near 1.0, far 4.0) instead of the
+        # LLFF default 0.001 end (depth 1000) — 8 planes can't afford to
+        # waste bins behind the far plane
+        "mpi.disparity_start": 1.0,
+        "mpi.disparity_end": 0.2,
+        "loss.smoothness_gmin": 0.8,
+        "loss.smoothness_grad_ratio": 0.2,
+        "training.epochs": 1,
+    })
+
+
+def psnr(a: np.ndarray, b: np.ndarray) -> float:
+    return float(-10.0 * np.log10(np.mean((a - b) ** 2) + 1e-12))
+
+
+def eval_novel_pose_psnr(cfg, params, batch_stats, phase: float) -> dict:
+    """Predict an MPI from one held-out src image, render NOVEL poses, score
+    against the analytic renderer. Returns per-pose and mean PSNR."""
+    import jax.numpy as jnp
+
+    from mine_tpu.data.synthetic import _intrinsics, _render_view
+    from mine_tpu.inference.trajectory import poses_from_offsets
+    from mine_tpu.inference.video import predict_blended_mpi, render_many
+
+    h, w = cfg.data.img_h, cfg.data.img_w
+    k = _intrinsics(h, w)
+    src_img, _ = _render_view(h, w, k, np.zeros(3), phase)
+
+    disparity = jnp.linspace(
+        cfg.mpi.disparity_start, cfg.mpi.disparity_end, cfg.mpi.num_bins_coarse
+    )[None, :]
+    variables = {"params": params, "batch_stats": batch_stats}
+    mpi_rgb, mpi_sigma = predict_blended_mpi(
+        cfg, variables, jnp.asarray(src_img)[None], disparity, jnp.asarray(k)[None]
+    )
+    rgb, _ = render_many(
+        cfg, mpi_rgb, mpi_sigma, disparity,
+        jnp.asarray(k)[None], jnp.asarray(poses_from_offsets(NOVEL_OFFSETS)),
+    )
+    rgb = np.asarray(rgb)
+
+    scores = []
+    for i, offset in enumerate(NOVEL_OFFSETS):
+        want, _ = _render_view(h, w, k, -offset, phase)
+        scores.append(psnr(rgb[i, CROP:-CROP, CROP:-CROP],
+                           want[CROP:-CROP, CROP:-CROP]))
+    return {"psnr_per_pose": [round(s, 3) for s in scores],
+            "psnr_novel": round(float(np.mean(scores)), 3)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--eval-every", type=int, default=100)
+    ap.add_argument("--height", type=int, default=128)
+    ap.add_argument("--width", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--planes", type=int, default=8)
+    ap.add_argument("--out", default="workspace/convergence")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the full forcing recipe (env flags + in-process jax.config update +
+        # fast-compile LLVM flag + persistent compilation cache) — the env
+        # var alone is NOT enough: the axon TPU PJRT plugin self-registers
+        # regardless of JAX_PLATFORMS, and its first backend touch can hang
+        # on a dead tunnel
+        from __graft_entry__ import _force_virtual_cpu_mesh
+
+        _force_virtual_cpu_mesh(1, fast_compile=True)
+        import jax
+    else:
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", str(
+                Path(__file__).resolve().parent.parent / ".jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
+
+    from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.training import (
+        build_model, init_state, make_optimizer, make_train_step,
+    )
+
+    cfg = build_cfg(args.height, args.width, args.batch, args.planes, args.steps)
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=args.steps)
+    state = init_state(cfg, model, tx, jax.random.PRNGKey(cfg.training.seed))
+    step_fn = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
+
+    os.makedirs(args.out, exist_ok=True)
+    curve_path = os.path.join(args.out, "curve.jsonl")
+    curve = open(curve_path, "a")
+
+    # held-out scene: a phase the training stream cannot also draw
+    # (training phases come from seeded default_rng; just pick a constant)
+    heldout_phase = 2.5
+
+    t0 = time.time()
+    losses = []
+    for step in range(1, args.steps + 1):
+        batch_np = make_synthetic_batch(
+            args.batch, args.height, args.width, n_points=256,
+            seed=args.seed * 7_777_777 + step,
+        )
+        batch_np.pop("src_depth")
+        state, loss_dict = step_fn(state, batch_np)
+        if step % 10 == 0 or step == 1:
+            losses.append(float(loss_dict["loss"]))
+        if step % args.eval_every == 0 or step == args.steps:
+            metrics = eval_novel_pose_psnr(
+                cfg, state.params, state.batch_stats, heldout_phase
+            )
+            row = {
+                "step": step,
+                "loss": round(float(loss_dict["loss"]), 4),
+                **metrics,
+                "elapsed_s": round(time.time() - t0, 1),
+            }
+            curve.write(json.dumps(row) + "\n")
+            curve.flush()
+            print(json.dumps(row), file=sys.stderr, flush=True)
+
+    final = {
+        "metric": "synthetic_novel_pose_psnr_after_training",
+        "steps": args.steps,
+        "final_loss": round(float(loss_dict["loss"]), 4),
+        **eval_novel_pose_psnr(cfg, state.params, state.batch_stats, heldout_phase),
+        "curve": curve_path,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    curve.close()
+    print(json.dumps(final))
+
+
+if __name__ == "__main__":
+    main()
