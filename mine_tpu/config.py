@@ -67,6 +67,12 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # wrap the decoder apply in jax.checkpoint to trade FLOPs for HBM
     remat_decoder: bool = False
+    # round decoder up-stage conv widths UP to this multiple (1 = the
+    # reference's exact [16,32,64,128,256] widths). A perf experiment knob:
+    # the narrow stages use a sliver of the 128-wide MXU, so padded widths
+    # waste FLOPs but can still win wall-clock. Changes the architecture —
+    # checkpoints are incompatible across different values
+    decoder_width_multiple: int = 1
 
 
 @dataclass(frozen=True)

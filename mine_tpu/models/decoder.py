@@ -116,6 +116,11 @@ class MPIDecoder(nn.Module):
     axis_name: str | tuple[str, ...] | None = None
     plane_axis: str | None = None
     dtype: Any = jnp.float32
+    # round up-stage widths UP to this multiple (model.decoder_width_multiple;
+    # 1 = exact reference widths). The narrow 16/32-ch stages drive the MXU
+    # at a fraction of its 128 lanes — padding trades wasted FLOPs for
+    # better tiling; measure, don't assume
+    width_multiple: int = 1
 
     @nn.compact
     def __call__(
@@ -181,9 +186,11 @@ class MPIDecoder(nn.Module):
         carry the per-plane conditioning, so BN stats pool over the plane
         mesh axis too (matching the unsharded B*S batch statistics)."""
         stage_axes = join_axis_names(self.axis_name, self.plane_axis)
-        up0 = ConvBlock(NUM_CH_DEC[i], stage_axes, self.dtype,
+        m = max(self.width_multiple, 1)
+        width = -(-NUM_CH_DEC[i] // m) * m
+        up0 = ConvBlock(width, stage_axes, self.dtype,
                         name=f"upconv_{i}_0")
-        up1 = ConvBlock(NUM_CH_DEC[i], stage_axes, self.dtype,
+        up1 = ConvBlock(width, stage_axes, self.dtype,
                         name=f"upconv_{i}_1")
 
         def run(x: Array, skip: Array | None) -> Array:

@@ -39,6 +39,7 @@ class MPINetwork(nn.Module):
     # only the decoder's post-conditioning BNs sync over it (decoder.py)
     plane_axis: str | None = None
     dtype: Any = jnp.float32
+    decoder_width_multiple: int = 1  # perf knob, see decoder.py
 
     @nn.compact
     def __call__(self, src_imgs: Array, disparity: Array, train: bool = True):
@@ -50,7 +51,8 @@ class MPINetwork(nn.Module):
             multires=self.multires, use_alpha=self.use_alpha,
             scales=self.scales, sigma_dropout_rate=self.sigma_dropout_rate,
             axis_name=self.axis_name, plane_axis=self.plane_axis,
-            dtype=self.dtype, name="decoder",
+            dtype=self.dtype, width_multiple=self.decoder_width_multiple,
+            name="decoder",
         )(feats, disparity, train)
 
 

@@ -21,7 +21,9 @@ Implementation: two paths with identical semantics.
     restructures the warp around Mosaic's native in-tile lane gather
     (59x faster at the LLFF bench shapes), with the backward scatter as a
     one-hot-MXU kernel and elementwise coordinate cotangents from saved
-    corner values (custom_vjp below).
+    corner values (custom_vjp below). Sources past the VMEM budget switch
+    to the DMA-banded kernel variants (HBM-resident image, per-tile bbox
+    traffic), so full-res shapes stay off the XLA gather too.
 Set MINE_TPU_DISABLE_PALLAS_WARP=1 to force the XLA path everywhere.
 """
 
@@ -82,11 +84,37 @@ def _grid_sample_xla(src: Array, coords: Array) -> Array:
 _INTERPRET = False
 
 
+def _banded_disabled() -> bool:
+    """MINE_TPU_DISABLE_BANDED_WARP=1 restores the round-3 behavior for
+    beyond-VMEM sources (slow XLA gather) without touching the
+    hardware-proven resident kernel — the safety valve until the banded
+    kernels' Mosaic lowering has run on a real chip (interpret mode
+    validates semantics, not Mosaic's layout/DMA constraints)."""
+    return os.environ.get("MINE_TPU_DISABLE_BANDED_WARP", "").lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+def _warp_fwd_fn(src: Array):
+    """Resident kernel when the padded source fits the VMEM budget, the
+    DMA-banded kernel beyond it (1008x756 full-res LLFF and the like)."""
+    from mine_tpu.ops.pallas import warp
+
+    return warp.warp_bilinear_chw if _fits_vmem(src) else warp.warp_bilinear_chw_banded
+
+
+def _warp_grad_fn(src: Array):
+    from mine_tpu.ops.pallas import warp
+
+    return (
+        warp.warp_bilinear_grad_chw if _fits_vmem(src)
+        else warp.warp_bilinear_grad_chw_banded
+    )
+
+
 @jax.custom_vjp
 def _grid_sample_pallas(src: Array, coords: Array) -> Array:
-    from mine_tpu.ops.pallas.warp import warp_bilinear_chw
-
-    out = warp_bilinear_chw(
+    out = _warp_fwd_fn(src)(
         jnp.moveaxis(src, -1, 1), coords[..., 0], coords[..., 1],
         interpret=_INTERPRET,
     )
@@ -107,19 +135,17 @@ def _pallas_bwd(res, g):
     corner values re-gathered by a second forward-kernel pass
     (d out/d wx = (a01-a00)(1-wy)+(a11-a10)wy etc.), masked where the border
     clamp saturates — matching jnp.clip's VJP in the XLA path."""
-    from mine_tpu.ops.pallas.warp import warp_bilinear_chw, warp_bilinear_grad_chw
-
     src, coords = res
     _, h, w, _ = src.shape
-    _, corners = warp_bilinear_chw(
+    _, corners = _warp_fwd_fn(src)(
         jnp.moveaxis(src, -1, 1), coords[..., 0], coords[..., 1],
         interpret=_INTERPRET, save_corners=True,
     )
     g_chw = jnp.moveaxis(g, -1, 1)
 
     grad_src = jnp.moveaxis(
-        warp_bilinear_grad_chw(coords[..., 0], coords[..., 1], g_chw, h, w,
-                               interpret=_INTERPRET),
+        _warp_grad_fn(src)(coords[..., 0], coords[..., 1], g_chw, h, w,
+                           interpret=_INTERPRET),
         1, -1,
     )
 
@@ -140,20 +166,20 @@ def _pallas_bwd(res, g):
 _grid_sample_pallas.defvjp(_pallas_fwd, _pallas_bwd)
 
 
-# The warp kernel keeps one whole padded (C, Hp, Wp) source image resident in
+# The resident warp kernel keeps one whole padded (C, Hp, Wp) source image in
 # VMEM (~16 MB/core, shared with the coord/output blocks and their double
-# buffers). Above this budget the XLA path takes over — slow but correct —
-# rather than an opaque Mosaic allocation failure. A row-banded kernel is the
-# upgrade path if full-res (e.g. 1008x756 LLFF eval) warps ever dominate.
+# buffers). Above this budget the DMA-banded kernel takes over (warp.py
+# warp_bilinear_chw_banded): the source stays in HBM and only each output
+# tile's bbox tiles travel, so full-res shapes (1008x756 LLFF eval, 21.8 MB
+# fp32) stay on the Pallas path instead of XLA's ~100x-off gather.
 _VMEM_SRC_BUDGET_BYTES = 8 * 1024 * 1024
 
 
 def _fits_vmem(src: Array) -> bool:
-    from mine_tpu.ops.pallas.warp import TILE_H, TILE_W
+    from mine_tpu.ops.pallas.warp import padded_dims
 
     _, h, w, c = src.shape
-    hp = max(h + (-h) % TILE_H, TILE_H)
-    wp = max(w + (-w) % TILE_W, TILE_W)
+    hp, wp = padded_dims(h, w)
     return c * hp * wp * src.dtype.itemsize <= _VMEM_SRC_BUDGET_BYTES
 
 
@@ -170,7 +196,7 @@ def grid_sample_pixel(src: Array, coords: Array) -> Array:
         jax.default_backend() == "tpu"
         and os.environ.get("MINE_TPU_DISABLE_PALLAS_WARP", "").lower()
         not in ("1", "true", "yes", "on")
-        and _fits_vmem(src)
+        and (_fits_vmem(src) or not _banded_disabled())
     ):
         return _grid_sample_pallas(src, coords)
     return _grid_sample_xla(src, coords)

@@ -71,6 +71,7 @@ def build_model(
         axis_name=axis_name,
         plane_axis=plane_axis,
         dtype=jnp.bfloat16 if cfg.model.dtype == "bfloat16" else jnp.float32,
+        decoder_width_multiple=cfg.model.decoder_width_multiple,
     )
 
 

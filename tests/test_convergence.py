@@ -1,0 +1,46 @@
+"""Trained-to-quality evidence (opt-in): the framework must TRAIN, not just
+step. Runs tools/convergence_run.py's harness for a few hundred steps on the
+procedural synthetic scene family and asserts novel-pose PSNR against the
+analytic renderer improves decisively over the untrained model.
+
+Opt-in via MINE_TPU_RUN_CONVERGENCE=1 (~20+ min of wall-clock on this 1-core
+CPU host — far past the normal suite budget; the default suite keeps the
+8-step loss-decrease test as its floor). The full 1000-step curve lives in
+BASELINE.md; reference analog: the reference's only quality evidence is a
+full GPU-days LLFF run (synthesis_task.py:496-527).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        os.environ.get("MINE_TPU_RUN_CONVERGENCE") != "1",
+        reason="set MINE_TPU_RUN_CONVERGENCE=1 (adds ~20+ min on 1 CPU core)",
+    ),
+]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_novel_pose_psnr_improves(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "convergence_run.py"),
+         "--steps", "300", "--eval-every", "300",
+         "--out", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=45 * 60, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    final = json.loads(out.stdout.strip().splitlines()[-1])
+    # calibration (r4, this host): untrained 13.2 dB; measured 15.4 @ step
+    # 100, 15.8 @ 200, 15.7 @ 300 — threshold sits ~1 dB under the measured
+    # plateau, ~1.5 dB above untrained. (The PSNR ceiling here is set by the
+    # S=8 plane quantization of the scene's depth-4 content, not by the
+    # trainer; the 1000-step BASELINE.md run records the full curve.)
+    assert final["psnr_novel"] > 14.7, final

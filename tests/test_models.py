@@ -68,6 +68,32 @@ def test_decoder_mpi_shapes_and_ranges():
     assert (sigma >= 1e-4).all()  # abs + 1e-4 activation
 
 
+def test_decoder_width_multiple_pads_up():
+    """model.decoder_width_multiple rounds up-stage widths UP to a multiple
+    (MXU-tiling perf knob); outputs keep their shapes, params get wider, and
+    the default of 1 preserves the reference's exact widths."""
+    b, s, h, w = 1, 2, 128, 128
+    chans = encoder_channels(18)
+    feats = [
+        jnp.ones((b, h // 2 ** (i + 1), w // 2 ** (i + 1), c)) * 0.1
+        for i, c in enumerate(chans)
+    ]
+    disp = jnp.linspace(1.0, 0.01, s)[None]
+    dec = MPIDecoder(multires=4, width_multiple=64)
+    vars_ = dec.init(jax.random.PRNGKey(0), feats, disp, train=False)
+    out = dec.apply(vars_, feats, disp, train=False)
+    for sc in range(4):
+        assert out[sc].shape == (b, s, h // 2**sc, w // 2**sc, 4)
+    # stage 0's reference width is 16 -> padded to 64
+    k = vars_["params"]["upconv_0_0"]["Conv3x3_0"]["Conv_0"]["kernel"]
+    assert k.shape[-1] == 64
+    # default stays at the reference widths
+    dec1 = MPIDecoder(multires=4)
+    vars1 = dec1.init(jax.random.PRNGKey(0), feats, disp, train=False)
+    k1 = vars1["params"]["upconv_0_0"]["Conv3x3_0"]["Conv_0"]["kernel"]
+    assert k1.shape[-1] == 16
+
+
 def test_decoder_bn_mutates_in_train_mode():
     b, s = 1, 2
     chans = encoder_channels(18)

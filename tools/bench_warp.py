@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Micro-benchmark for the warp kernels: resident vs DMA-banded vs XLA.
+
+Times the bilinear warp forward and its source-cotangent scatter at a given
+shape on the current backend (meant for the real TPU; CPU works but measures
+nothing interesting). Completion is forced by host-fetching a value —
+jax.block_until_ready returns early over this environment's tunneled TPU.
+
+  python tools/bench_warp.py --n 64 --h 384 --w 512 --c 7        # bench shape
+  python tools/bench_warp.py --n 32 --h 756 --w 1008 --c 7       # full-res
+  python tools/bench_warp.py ... --mode banded --grad
+
+Prints one JSON line per timed variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def bench(fn, force, iters: int = 10, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        out = fn()
+    force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    force(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--h", type=int, default=384)
+    ap.add_argument("--w", type=int, default=512)
+    ap.add_argument("--c", type=int, default=7)
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--mode", default="auto",
+                    choices=("auto", "resident", "banded", "xla"))
+    ap.add_argument("--grad", action="store_true",
+                    help="also time the source-cotangent scatter kernel")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import mine_tpu.ops.grid_sample as gs
+    from mine_tpu.ops.pallas import warp
+
+    n, h, w, c = args.n, args.h, args.w, args.c
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.uniform(size=(n, c, h, w)), dtype)
+    # near-identity homography-ish coords: small shifts, like a plane sweep
+    base_x = np.tile(np.arange(w, dtype=np.float32), (h, 1))
+    base_y = np.tile(np.arange(h, dtype=np.float32)[:, None], (1, w))
+    cx = jnp.asarray(base_x[None] + rng.uniform(-30, 30, size=(n, 1, 1)))
+    cy = jnp.asarray(base_y[None] + rng.uniform(-10, 10, size=(n, 1, 1)))
+    g = jnp.asarray(rng.normal(size=(n, c, h, w)), dtype)
+
+    src_nhwc = jnp.moveaxis(src, 1, -1)
+    fits = gs._fits_vmem(src_nhwc)
+    interpret = jax.default_backend() != "tpu"
+
+    def force(x):
+        leaf = x[0] if isinstance(x, (tuple, list)) else x
+        float(jnp.sum(leaf[0, 0].astype(jnp.float32)))
+
+    variants = []
+    mode = args.mode
+    if mode == "auto":
+        variants = [("resident" if fits else "banded"), "xla"]
+    else:
+        variants = [mode]
+
+    src_bytes = n * c * h * w * dtype.itemsize
+    for v in variants:
+        if v == "xla":
+            coords = jnp.stack([cx, cy], axis=-1)
+            f = jax.jit(gs._grid_sample_xla)
+            dt = bench(lambda: f(src_nhwc, coords), force, args.iters)
+        else:
+            kfn = (warp.warp_bilinear_chw if v == "resident"
+                   else warp.warp_bilinear_chw_banded)
+            f = jax.jit(lambda s, x, y: kfn(s, x, y, interpret=interpret))
+            dt = bench(lambda: f(src, cx, cy), force, args.iters)
+        print(json.dumps({
+            "metric": f"warp_fwd_{v}", "n": n, "h": h, "w": w, "c": c,
+            "dtype": args.dtype, "ms": round(dt * 1e3, 2),
+            "gb_per_s": round(2 * src_bytes / dt / 1e9, 1),
+            "backend": jax.default_backend(),
+        }))
+        if args.grad and v != "xla":
+            gfn = (warp.warp_bilinear_grad_chw if v == "resident"
+                   else warp.warp_bilinear_grad_chw_banded)
+            fg = jax.jit(lambda x, y, gg: gfn(x, y, gg, h, w,
+                                              interpret=interpret))
+            dt = bench(lambda: fg(cx, cy, g), force, args.iters)
+            # scatter traffic: read g once + read-modify-write the padded
+            # gradient image (bbox revisits add more; this is the floor)
+            hp, wp = warp.padded_dims(h, w)
+            grad_bytes = (n * c * h * w + 2 * n * c * hp * wp) * dtype.itemsize
+            print(json.dumps({
+                "metric": f"warp_grad_{v}", "n": n, "h": h, "w": w, "c": c,
+                "dtype": args.dtype, "ms": round(dt * 1e3, 2),
+                "gb_per_s": round(grad_bytes / dt / 1e9, 1),
+                "backend": jax.default_backend(),
+            }))
+
+
+if __name__ == "__main__":
+    main()

@@ -158,6 +158,7 @@ def main() -> None:
 
     t0 = time.time()
     losses = []
+    metrics = None
     for step in range(1, args.steps + 1):
         batch_np = make_synthetic_batch(
             args.batch, args.height, args.width, n_points=256,
@@ -181,11 +182,15 @@ def main() -> None:
             curve.flush()
             print(json.dumps(row), file=sys.stderr, flush=True)
 
+    if metrics is None:  # loop always evaluates at step == args.steps
+        metrics = eval_novel_pose_psnr(
+            cfg, state.params, state.batch_stats, heldout_phase
+        )
     final = {
         "metric": "synthetic_novel_pose_psnr_after_training",
         "steps": args.steps,
         "final_loss": round(float(loss_dict["loss"]), 4),
-        **eval_novel_pose_psnr(cfg, state.params, state.batch_stats, heldout_phase),
+        **metrics,
         "curve": curve_path,
         "wall_s": round(time.time() - t0, 1),
     }
