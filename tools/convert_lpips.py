@@ -12,6 +12,11 @@ Usage:
 With no --vgg-state/--lin-state it tries `import lpips` and extracts from the
 live module. Conv weights are transposed OIHW -> HWIO (NHWC convs); lin
 weights are the non-negative 1x1 conv kernels flattened to (C,).
+
+Validation status (no-egress environment): validated against a torch twin
+with the published lpips key layout (tests/test_losses.py); a genuine
+`lpips` package state_dict has never been parsed here. The strict
+key/shape checks raise on drift rather than mis-mapping.
 """
 
 from __future__ import annotations

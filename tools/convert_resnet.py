@@ -22,6 +22,13 @@ weights; :86-87 documents the x4 bottleneck widths the mapping preserves):
       across all stages in order (the flax auto-naming of
       mine_tpu/models/encoder.py).
   fc.* (the ImageNet classifier head) is dropped — the encoder is headless.
+
+Validation status (no-egress environment): the key mapping is pinned by
+tests/test_pretrained.py against a torch twin that reproduces torchvision's
+published layout (conv1/bn1/layerN.M...), but a genuine downloaded
+torchvision state_dict has never been parsed here. Residual risk is
+key-name drift in future torchvision releases; the strict mapper raises
+on any unknown or missing key rather than mis-mapping.
 """
 
 from __future__ import annotations
