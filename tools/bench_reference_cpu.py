@@ -1,0 +1,389 @@
+#!/usr/bin/env python3
+"""Same-host denominator: the reference's torch train step vs ours, on CPU.
+
+The reference publishes no throughput anywhere (SURVEY.md §6) and no GPU
+exists in this environment, so every bench so far carried `vs_baseline:
+null`. This tool produces the one comparison the environment CAN support:
+both frameworks running the identical LLFF-recipe training step on the same
+host CPU. It is not the V100 north star (BASELINE.md) — XLA-CPU vs torch-CPU
+does not predict TPU vs GPU — but it is a measured, same-hardware,
+same-workload number instead of none.
+
+Reference side (`--side ref`): the step is assembled from the reference's
+OWN modules — `operations/mpi_rendering.py`, `operations/homography_sampler.py`,
+`operations/rendering_utils.py`, `network/ssim.py`, `network/layers.py`
+(edge_aware_loss, edge_aware_loss_v2), `network/monodepth2/depth_decoder.py`,
+`utils.get_embedder` — composed exactly as `synthesis_task.py:234-418`
+(loss_fcn → loss_fcn_per_scale at 4 scales → backward → two-group Adam).
+Two substitutions, both forced by missing wheels and both parity-tested:
+
+- encoder: torchvision is not installed, so the backbone is the torch twin
+  `tests/test_pretrained._TorchPyramid` (torchvision-format ResNet, feature-
+  pyramid parity vs our flax encoder in test_pretrained.py) wrapped with the
+  ImageNet normalization `ResnetEncoder.forward` applies (resnet_encoder.py:
+  94-100). Same architecture, same FLOPs, same layer shapes.
+- kornia is not installed, so `kornia.filters.spatial_gradient` is stubbed
+  with the documented equivalent sobel (replicate padding, /8 when
+  normalized) — the same semantics `mine_tpu/losses/smoothness.py` replicates
+  and `tests/test_losses.py` pins against the formula.
+
+CUDA-only scar tissue in the reference (`.cuda()` calls inside
+edge_aware_loss, `torch.cuda.synchronize` in loss_fcn_per_scale) is no-op'd
+the same way `tests/test_golden_parity.py` does.
+
+Our side (`--side ours`): `training/step.py`'s jitted train step, fp32 (CPU
+has no MXU; bf16 is a TPU concern), same shapes, same batch, compile
+excluded from timing (torch has no compile step to exclude).
+
+  python tools/bench_reference_cpu.py --side ref   --steps 3
+  python tools/bench_reference_cpu.py --side ours  --steps 3
+
+Prints one JSON line per run; `tools/tpu_watch.sh`-style orchestration or a
+caller script can merge the two into a ratio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import types
+from pathlib import Path
+
+import numpy as np
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+REFERENCE_ROOT = "/root/reference"
+sys.path.insert(0, str(REPO_ROOT))
+
+H, W = 384, 512  # LLFF recipe (configs/params_llff.yaml)
+S = 32
+N_PT = 256
+
+
+def make_batch(batch: int, seed: int = 0) -> dict:
+    from mine_tpu.data import make_synthetic_batch
+
+    b = make_synthetic_batch(batch, H, W, n_points=N_PT, seed=seed)
+    b.pop("src_depth")
+    return b
+
+
+# ---------------------------------------------------------------- reference
+
+
+def _install_stubs():
+    """Make the reference's modules importable without torchvision/kornia,
+    and its CUDA-only calls harmless, mirroring tests/test_golden_parity.py."""
+    import torch
+
+    # kornia.filters.spatial_gradient: 3x3 sobel, replicate padding,
+    # kernel/8 when normalized, output B,C,2,H,W (x then y) — the semantics
+    # documented and replicated in mine_tpu/losses/smoothness.py:3-44
+    def spatial_gradient(x, mode="sobel", order=1, normalized=True):
+        kx = torch.tensor(
+            [[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]],
+            dtype=x.dtype, device=x.device,
+        )
+        if normalized:
+            kx = kx / 8.0
+        ky = kx.t()
+        c = x.shape[1]
+        k = torch.stack([kx, ky])[:, None].repeat(c, 1, 1, 1)  # (2C,1,3,3)
+        xp = torch.nn.functional.pad(x, (1, 1, 1, 1), mode="replicate")
+        g = torch.nn.functional.conv2d(xp, k, groups=c)  # B,(2C),H,W
+        return g.view(x.shape[0], c, 2, x.shape[2], x.shape[3])
+
+    kornia = types.ModuleType("kornia")
+    kfilters = types.ModuleType("kornia.filters")
+    kfilters.spatial_gradient = spatial_gradient
+    kornia.filters = kfilters
+    sys.modules.setdefault("kornia", kornia)
+    sys.modules.setdefault("kornia.filters", kfilters)
+    # network/layers.py does `import torchvision` at module scope but only
+    # touches it inside VGGPerceptualLoss, which this benchmark never builds
+    sys.modules.setdefault("torchvision", types.ModuleType("torchvision"))
+
+    torch.cuda.synchronize = lambda *a, **k: None
+    torch.Tensor.cuda = lambda self, *a, **k: self
+    torch.nn.Module.cuda = lambda self, *a, **k: self
+
+
+class _NormalizedBackbone:
+    """_TorchPyramid + the ImageNet normalization ResnetEncoder.forward
+    applies before conv1 (resnet_encoder.py:94-100)."""
+
+    def __init__(self, num_layers: int):
+        import torch
+
+        from tests.test_pretrained import _TorchPyramid
+
+        self.net = _TorchPyramid(num_layers).train()
+        self.mean = torch.tensor([0.485, 0.456, 0.406]).view(1, 3, 1, 1)
+        self.std = torch.tensor([0.229, 0.224, 0.225]).view(1, 3, 1, 1)
+
+    def parameters(self):
+        return self.net.parameters()
+
+    def __call__(self, x):
+        return self.net((x - self.mean) / self.std)
+
+
+def run_reference(batch_size: int, steps: int, warmup: int) -> dict:
+    import torch
+
+    _install_stubs()
+    sys.path.insert(0, REFERENCE_ROOT)
+    from network.layers import edge_aware_loss, edge_aware_loss_v2, psnr
+    from network.monodepth2.depth_decoder import DepthDecoder
+    from network.ssim import SSIM
+    from operations import mpi_rendering, rendering_utils
+    from operations.homography_sampler import HomographySample
+    from utils import get_embedder
+
+    torch.manual_seed(0)
+    embedder, e_dim = get_embedder(4)
+    backbone = _NormalizedBackbone(50)
+    decoder = DepthDecoder(
+        num_ch_enc=np.array([64, 256, 512, 1024, 2048]),
+        embedder=embedder,
+        embedder_out_dim=e_dim,
+        use_alpha=False,
+        num_output_channels=4,
+        scales=range(4),
+        use_skips=True,
+    ).train()
+    optimizer = torch.optim.Adam(
+        [
+            {"params": backbone.parameters(), "lr": 1e-3},
+            {"params": decoder.parameters(), "lr": 1e-3},
+        ],
+        weight_decay=0.0,
+    )
+
+    device = torch.device("cpu")
+    samplers = [
+        HomographySample(H // 2**s, W // 2**s, device=device) for s in range(4)
+    ]
+    upsample = [torch.nn.Identity()] + [
+        torch.nn.Upsample(size=(H // 2**s, W // 2**s)) for s in (1, 2, 3)
+    ]
+    ssim = SSIM(size_average=True)
+
+    b = make_batch(batch_size)
+    src = torch.from_numpy(np.moveaxis(b["src_img"], -1, 1).copy())
+    tgt = torch.from_numpy(np.moveaxis(b["tgt_img"], -1, 1).copy())
+    K = torch.from_numpy(b["k_src"].copy())
+    G_tgt_src = torch.from_numpy(b["g_tgt_src"].copy())
+    pt3d_src = torch.from_numpy(np.swapaxes(b["pt3d_src"], 1, 2).copy())
+    pt3d_tgt = torch.from_numpy(np.swapaxes(b["pt3d_tgt"], 1, 2).copy())
+
+    def mpi_predictor(src_imgs, disparity):
+        feats = backbone(src_imgs)
+        outputs = decoder(feats, disparity)
+        return [outputs[("disp", i)] for i in range(4)]
+
+    def one_step() -> float:
+        # synthesis_task.network_forward (S_fine=0 branch, this fork's recipe)
+        disparity = rendering_utils.uniformly_sample_disparity_from_linspace_bins(
+            batch_size=batch_size, num_bins=S, start=1.0, end=0.001,
+            device=device,
+        )
+        mpi_list, disparity_all = mpi_rendering.predict_mpi_coarse_to_fine(
+            mpi_predictor, src, None, disparity, 0, is_bg_depth_inf=False
+        )
+
+        # synthesis_task.loss_fcn: per-scale losses, scale_factor from scale 0
+        scale_factor = None
+        total = None
+        loss0 = {}
+        for scale in range(4):
+            mpi_all = mpi_list[scale]
+            src_s = upsample[scale](src)
+            tgt_s = upsample[scale](tgt)
+            B = src_s.shape[0]
+            K_s = K / (2**scale)
+            K_s[:, 2, 2] = 1
+            K_s_inv = torch.inverse(K_s)
+
+            xyz_src = mpi_rendering.get_src_xyz_from_plane_disparity(
+                samplers[scale].meshgrid, disparity_all, K_s_inv
+            )
+            rgb = mpi_all[:, :, 0:3]
+            sigma = mpi_all[:, :, 3:]
+            src_syn, src_depth, blend_w, weights = mpi_rendering.render(
+                rgb, sigma, xyz_src, use_alpha=False, is_bg_depth_inf=False
+            )
+            rgb = blend_w * src_s.unsqueeze(1) + (1 - blend_w) * rgb
+            src_syn, src_depth = mpi_rendering.weighted_sum_mpi(
+                rgb, xyz_src, weights, is_bg_depth_inf=False
+            )
+            src_disp_syn = torch.reciprocal(src_depth)
+
+            # scale factor from sparse points (synthesis_task.py:296-303)
+            pt_disp = torch.reciprocal(pt3d_src[:, 2:, :])
+            pt_pxpy = torch.matmul(K_s, pt3d_src)
+            pt_pxpy = pt_pxpy[:, 0:2] / pt_pxpy[:, 2:]
+            pt_disp_syn = rendering_utils.gather_pixel_by_pxpy(
+                src_disp_syn, pt_pxpy
+            )
+            if scale_factor is None:
+                scale_factor = torch.exp(torch.mean(
+                    torch.log(pt_disp_syn) - torch.log(pt_disp),
+                    dim=2,
+                )).squeeze(1)
+
+            # render tgt (synthesis_task.render_novel_view)
+            with torch.no_grad():
+                G = torch.clone(G_tgt_src)
+                G[:, 0:3, 3] = G[:, 0:3, 3] / scale_factor.view(-1, 1)
+            xyz_tgt = mpi_rendering.get_tgt_xyz_from_plane_disparity(
+                mpi_rendering.get_src_xyz_from_plane_disparity(
+                    samplers[scale].meshgrid, disparity_all, K_s_inv
+                ),
+                G,
+            )
+            tgt_syn, tgt_depth, tgt_mask = mpi_rendering.render_tgt_rgb_depth(
+                samplers[scale], rgb, sigma, disparity_all, xyz_tgt, G,
+                K_s_inv, K_s, use_alpha=False, is_bg_depth_inf=False,
+            )
+            tgt_disp_syn = torch.reciprocal(tgt_depth)
+
+            # losses (synthesis_task.py:316-384)
+            with torch.no_grad():
+                loss_rgb_src = torch.mean(torch.abs(src_syn - src_s))
+                loss_ssim_src = 1 - ssim(src_syn, src_s)
+                _ = edge_aware_loss(src_s, src_disp_syn, gmin=0.8,
+                                    grad_ratio=0.2)
+            pt_disp_syn_scaled = pt_disp_syn / scale_factor.view(B, 1, 1)
+            loss_disp_src = torch.mean(torch.abs(
+                torch.log(pt_disp_syn_scaled) - torch.log(pt_disp)
+            ))
+            tgt_pt_disp = torch.reciprocal(pt3d_tgt[:, 2:, :])
+            tgt_pt_pxpy = torch.matmul(K_s, pt3d_tgt)
+            tgt_pt_pxpy = tgt_pt_pxpy[:, 0:2] / tgt_pt_pxpy[:, 2:]
+            tgt_pt_disp_syn = rendering_utils.gather_pixel_by_pxpy(
+                tgt_disp_syn, tgt_pt_pxpy
+            )
+            loss_disp_tgt = torch.mean(torch.abs(
+                torch.log(tgt_pt_disp_syn / scale_factor.view(B, 1, 1))
+                - torch.log(tgt_pt_disp)
+            ))
+
+            valid = torch.ge(tgt_mask, 2.0).to(torch.float32)
+            loss_rgb_tgt = (torch.abs(tgt_syn - tgt_s) * valid).mean()
+            loss_smooth_tgt = 0.5 * edge_aware_loss(
+                tgt_s, tgt_disp_syn, gmin=0.8, grad_ratio=0.2
+            )
+            loss_smooth_tgt_v2 = 1.0 * edge_aware_loss_v2(tgt_s, tgt_disp_syn)
+            loss_smooth_src_v2 = 1.0 * edge_aware_loss_v2(src_s, src_disp_syn)
+            loss_ssim_tgt = 1 - ssim(tgt_syn, tgt_s)
+            with torch.no_grad():
+                _ = psnr(tgt_syn, tgt_s)
+
+            scale_loss = (
+                loss_disp_tgt + loss_disp_src + loss_rgb_tgt + loss_ssim_tgt
+                + loss_smooth_tgt + loss_smooth_src_v2 + loss_smooth_tgt_v2
+            )
+            if scale == 0:
+                total = scale_loss
+                loss0 = {"rgb_src": loss_rgb_src, "ssim_src": loss_ssim_src}
+            else:
+                # loss_fcn accumulation for scales 1-3 (synthesis_task.py:402-407)
+                total = total + (
+                    loss_rgb_tgt + loss_ssim_tgt + loss_disp_src
+                    + loss_disp_tgt + loss_smooth_src_v2 + loss_smooth_tgt_v2
+                )
+
+        optimizer.zero_grad()
+        total.backward()
+        optimizer.step()
+        del loss0
+        return float(total.detach())
+
+    for _ in range(warmup):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    elapsed = time.perf_counter() - t0
+    return {
+        "side": "reference-torch-cpu",
+        "imgs_per_sec": round(batch_size * steps / elapsed, 4),
+        "step_s": round(elapsed / steps, 2),
+        "loss": round(loss, 3),
+    }
+
+
+# --------------------------------------------------------------------- ours
+
+
+def run_ours(batch_size: int, steps: int, warmup: int) -> dict:
+    from __graft_entry__ import _force_virtual_cpu_mesh
+
+    _force_virtual_cpu_mesh(1, fast_compile=True)
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.config import Config
+    from mine_tpu.training import (
+        build_model, init_state, make_optimizer, make_train_step,
+    )
+
+    cfg = Config().replace(**{
+        "data.name": "llff",
+        "data.img_h": H, "data.img_w": W,
+        "data.per_gpu_batch_size": batch_size,
+        "mpi.num_bins_coarse": S,
+        "loss.smoothness_gmin": 0.8,
+        "loss.smoothness_grad_ratio": 0.2,
+        "model.dtype": "float32",  # CPU has no MXU; match torch fp32
+    })
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    step_fn = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
+
+    b = make_batch(batch_size)
+    batch = {k: jnp.asarray(v) for k, v in b.items()}
+
+    for _ in range(warmup):
+        state, loss_dict = step_fn(state, batch)
+        float(loss_dict["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss_dict = step_fn(state, batch)
+    loss = float(loss_dict["loss"])  # forces completion
+    elapsed = time.perf_counter() - t0
+    return {
+        "side": "mine-tpu-xla-cpu",
+        "imgs_per_sec": round(batch_size * steps / elapsed, 4),
+        "step_s": round(elapsed / steps, 2),
+        "loss": round(loss, 3),
+    }
+
+
+def main() -> None:
+    global H, W, S
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--side", choices=("ref", "ours"), required=True)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--height", type=int, default=H,
+                    help="override the recipe shape (smoke tests)")
+    ap.add_argument("--width", type=int, default=W)
+    ap.add_argument("--planes", type=int, default=S)
+    args = ap.parse_args()
+
+    H, W, S = args.height, args.width, args.planes
+    fn = run_reference if args.side == "ref" else run_ours
+    out = fn(args.batch, args.steps, args.warmup)
+    out.update({"batch": args.batch, "h": H, "w": W, "planes": S,
+                "encoder": "resnet50", "host_cores": 1})
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
