@@ -45,7 +45,7 @@ NOVEL_OFFSETS = np.array([
 CROP = 16  # interior crop: border band is clamp-padding, not scene content
 
 
-def build_cfg(height: int, width: int, batch: int, num_planes: int, steps: int,
+def build_cfg(height: int, width: int, batch: int, num_planes: int,
               disparity_end: float = 0.2):
     from mine_tpu.config import Config
 
@@ -164,7 +164,7 @@ def main() -> None:
     )
 
     cfg = build_cfg(args.height, args.width, args.batch, args.planes,
-                    args.steps, disparity_end=args.disparity_end)
+                    disparity_end=args.disparity_end)
     model = build_model(cfg)
     tx = make_optimizer(cfg, steps_per_epoch=args.steps)
     state = init_state(cfg, model, tx, jax.random.PRNGKey(cfg.training.seed))
@@ -178,9 +178,10 @@ def main() -> None:
     # (training phases come from seeded default_rng; fixed constants)
     heldout_phase = [2.5, 4.1, 0.7][: args.eval_phases]
 
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+
     t0 = time.time()
-    losses = []
-    metrics = None
     for step in range(1, args.steps + 1):
         batch_np = make_synthetic_batch(
             args.batch, args.height, args.width, n_points=256,
@@ -188,8 +189,6 @@ def main() -> None:
         )
         batch_np.pop("src_depth")
         state, loss_dict = step_fn(state, batch_np)
-        if step % 10 == 0 or step == 1:
-            losses.append(float(loss_dict["loss"]))
         if step % args.eval_every == 0 or step == args.steps:
             metrics = eval_novel_pose_psnr(
                 cfg, state.params, state.batch_stats, heldout_phase
@@ -204,10 +203,6 @@ def main() -> None:
             curve.flush()
             print(json.dumps(row), file=sys.stderr, flush=True)
 
-    if metrics is None:  # loop always evaluates at step == args.steps
-        metrics = eval_novel_pose_psnr(
-            cfg, state.params, state.batch_stats, heldout_phase
-        )
     final = {
         "metric": "synthetic_novel_pose_psnr_after_training",
         "steps": args.steps,
