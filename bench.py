@@ -101,6 +101,10 @@ def executable_flops(compiled) -> float | None:
 def main() -> None:
     import jax
 
+    from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
     init_ok = _arm_watchdog(INIT_TIMEOUT_S, "TPU backend init")
     jax.devices()
     init_ok.set()
@@ -125,6 +129,11 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
     from mine_tpu.data import make_synthetic_batch
     from mine_tpu.training import build_model, init_state, make_optimizer, make_train_step
 
+    # perf-experiment knob (BASELINE.md): round decoder up-stage conv widths
+    # up to a multiple of the 128-wide MXU lane count. 1 = exact reference
+    # widths; measurements with >1 are experiments, not the parity recipe.
+    width_multiple = int(os.environ.get("BENCH_WIDTH_MULTIPLE", "1"))
+
     def build(remat: bool):
         cfg = Config().replace(**{
             "data.name": "llff",
@@ -134,6 +143,7 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
             "loss.smoothness_gmin": 0.8,
             "loss.smoothness_grad_ratio": 0.2,
             "model.remat_decoder": remat,
+            "model.decoder_width_multiple": width_multiple,
         })
         model = build_model(cfg)
         tx = make_optimizer(cfg, steps_per_epoch=100)
@@ -211,6 +221,7 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
         "mfu": mfu,
         "step_ms": round(elapsed / MEASURE_STEPS * 1e3, 1),
         "remat": remat_used,
+        "width_multiple": width_multiple,
         "device": device.device_kind,
     }
 
@@ -229,6 +240,7 @@ def _run() -> None:
         "model_tflops_per_sec": primary["model_tflops_per_sec"],
         "mfu": primary["mfu"],
         "step_ms": primary["step_ms"],
+        "width_multiple": primary["width_multiple"],
         "device": primary["device"],
         "note": (
             "vs_baseline awaits a measured reference denominator (the "

@@ -62,6 +62,21 @@ def build_model(
     scales: which pyramid levels get output heads AND loss terms — the loss
     graph (loss_fcn) follows model.scales, so a reduced tuple shrinks the
     whole compiled step (used by the multichip dryrun; 0 must be included)."""
+    # Architecture constraint shared with the reference: the decoder's
+    # receptive-field extension pools the /32 feature twice and upsamples
+    # twice (depth_decoder.py:56-57, 93-96 — MaxPool2d(3,2,1) ceil-halves),
+    # so the round trip restores H/32 only when H/32 % 4 == 0, i.e. H and W
+    # must be multiples of 128 (all reference recipes are: 384x512, 768x256,
+    # 384x256, 512x384). Fail here with the real reason instead of a shape
+    # mismatch deep inside tracing.
+    for dim, name in ((cfg.data.img_h, "data.img_h"), (cfg.data.img_w, "data.img_w")):
+        if dim % 128 != 0:
+            raise ValueError(
+                f"{name}={dim} is not a multiple of 128; the MPI decoder's "
+                "encoder-extension (pool x2 + up x2 over the /32 feature) "
+                "requires it — same constraint as the reference "
+                "(depth_decoder.py:93-96)"
+            )
     return MPINetwork(
         num_layers=cfg.model.num_layers,
         multires=cfg.model.pos_encoding_multires,

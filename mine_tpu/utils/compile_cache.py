@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache, one switch for every entry point.
+
+The tunneled TPU backend's compile is slow (minutes for the full train
+step) and the tunnel itself is mortal — cache hits make repeat runs
+(bench re-invocations, width-knob experiments, profiler reruns, resumed
+convergence runs) near-free. Call before the first backend touch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_compile_cache(cache_dir: str | None = None) -> None:
+    """Point jax at an on-disk compile cache (repo-local by default).
+
+    Safe to call on any jax version/backend: unknown config names are
+    swallowed, matching the reference's attitude to optional accelerators.
+    """
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - config surface varies by version
+        pass

@@ -137,6 +137,8 @@ def main() -> None:
                     help="held-out scenes to average the eval over "
                          "(single-scene eval carries ~±1.5 dB noise)")
     args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # the full forcing recipe (env flags + in-process jax.config update +
@@ -151,12 +153,9 @@ def main() -> None:
     else:
         import jax
 
-        try:
-            jax.config.update("jax_compilation_cache_dir", str(
-                Path(__file__).resolve().parent.parent / ".jax_cache"))
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+        from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
 
     from mine_tpu.data import make_synthetic_batch
     from mine_tpu.training import (
@@ -177,9 +176,6 @@ def main() -> None:
     # held-out scenes: phases the training stream cannot also draw
     # (training phases come from seeded default_rng; fixed constants)
     heldout_phase = [2.5, 4.1, 0.7][: args.eval_phases]
-
-    if args.steps < 1:
-        ap.error("--steps must be >= 1")
 
     t0 = time.time()
     for step in range(1, args.steps + 1):
