@@ -1,0 +1,54 @@
+"""Oracle-MPI construction invariants (tools/oracle_mpi_ceiling.py).
+
+The oracle's PSNR numbers anchor BASELINE.md's interpretation of the
+convergence curves, so its construction must be provably right. These are
+pure-numpy checks on the alpha assignment — the rendering side is covered
+by the identity-pose sanity row the tool itself computes (119 dB src-pose
+reproduction) and by test_mpi_render's compositing twins.
+"""
+
+import numpy as np
+
+from tools.oracle_mpi_ceiling import oracle_alphas
+
+DISP = np.linspace(1.0, 0.2, 8).astype(np.float32)
+
+
+def _weights(alphas: np.ndarray) -> np.ndarray:
+    """Front-to-back compositing weights from per-plane alphas (S,H,W,1)."""
+    a = alphas[..., 0]
+    trans = np.cumprod(1.0 - a, axis=0)
+    trans = np.concatenate([np.ones_like(trans[:1]), trans[:-1]], axis=0)
+    return a * trans
+
+
+def test_soft_weights_preserve_expected_disparity():
+    # E[disp] under the compositing weights must equal the true disparity —
+    # the property that makes the soft oracle's novel-view parallax exact
+    # in expectation (between-plane depths render as a blend whose centroid
+    # sits at the right depth)
+    depth = np.array([[1.0, 2.0], [4.0, 3.3]], np.float32)
+    w = _weights(oracle_alphas(depth, DISP, "soft"))
+    np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-6)
+    exp_disp = (w * DISP[:, None, None]).sum(axis=0)
+    np.testing.assert_allclose(exp_disp, 1.0 / depth, atol=1e-6)
+
+
+def test_hard_is_one_hot_on_nearest_plane():
+    depth = np.array([[1.0, 4.0]], np.float32)
+    a = oracle_alphas(depth, DISP, "hard")[..., 0]
+    assert set(np.unique(a)) <= {0.0, 1.0}
+    assert a.sum(axis=0).tolist() == [[1.0, 1.0]]
+    # depth 1.0 = plane 0 exactly; depth 4.0 -> disp 0.25, nearest of
+    # {0.3143 (idx 6), 0.2 (idx 7)} is 0.2 -> idx 7
+    assert a[0, 0, 0] == 1.0
+    assert a[7, 0, 1] == 1.0
+
+
+def test_out_of_range_depth_clamps_to_end_planes():
+    depth = np.array([[0.5, 100.0]], np.float32)  # disp 2.0 and 0.01
+    for variant in ("soft", "hard"):
+        w = _weights(oracle_alphas(depth, DISP, variant))
+        np.testing.assert_allclose(w.sum(axis=0), 1.0, atol=1e-6)
+        assert w[0, 0, 0] == 1.0  # nearer than plane 0 -> all on plane 0
+        assert w[7, 0, 1] == 1.0  # farther than last plane -> all on last

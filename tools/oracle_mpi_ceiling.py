@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Representation ceiling of an S-plane MPI on the analytic scene — no
+training anywhere.
+
+The convergence runs (tools/convergence_run.py, BASELINE.md) plateau near
+17 dB novel-pose PSNR at S=8 and attribute it to plane quantization: the
+scene's depth-4 surface falls between the S=8 disparity bins. This tool
+tests that hypothesis directly. It builds the MPI FROM THE ANALYTIC SCENE
+ITSELF — per-pixel true disparity assigns each pixel's src color to its
+bracketing planes — then renders the same held-out novel poses through the
+same `render_many` path the trained-model eval uses, and scores against the
+analytic renderer. No network, no optimizer: the resulting PSNR is what a
+PERFECT S-plane MPI predictor could score, i.e. the representation ceiling
+the trainer is converging toward.
+
+Two oracle variants bound the ceiling from both sides:
+  hard: each pixel fully opaque on its nearest plane (what a confident
+        model that snaps depth to bins would do)
+  soft: alpha w on the nearer bracketing plane + opaque on the farther one
+        (the depth-blend a model free to split density can express)
+
+  python tools/oracle_mpi_ceiling.py --planes 8 16 32
+
+Prints one JSON line per (S, variant). A src-pose render sanity row is
+included: the oracle composited at the SOURCE pose must reproduce the src
+image nearly exactly (alpha sums to 1 along every ray), which pins any
+surprise to novel-pose parallax, not to the construction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.convergence_run import CROP, NOVEL_OFFSETS, build_cfg, psnr  # noqa: E402
+
+EVAL_PHASES = [2.5, 4.1, 0.7]  # the convergence runs' held-out scenes
+
+
+def oracle_alphas(
+    depth: np.ndarray, disp_planes: np.ndarray, variant: str
+) -> np.ndarray:
+    """(H,W) true depth + (S,) descending plane disparities -> (S,H,W,1)
+    per-plane alpha, front (highest disparity) first."""
+    s = disp_planes.shape[0]
+    disp_true = np.clip(1.0 / depth, disp_planes[-1], disp_planes[0])
+    alphas = np.zeros((s,) + depth.shape, np.float32)
+    # bracketing indices: a = nearer plane (disp_a >= disp_true), b = a+1
+    # (descending disparity), weight w -> plane a, 1-w -> plane b
+    idx_b = np.searchsorted(-disp_planes, -disp_true, side="right")
+    idx_b = np.clip(idx_b, 1, s - 1)
+    idx_a = idx_b - 1
+    da, db = disp_planes[idx_a], disp_planes[idx_b]
+    w = (disp_true - db) / np.maximum(da - db, 1e-12)
+    hh, ww = np.meshgrid(
+        np.arange(depth.shape[0]), np.arange(depth.shape[1]), indexing="ij"
+    )
+    if variant == "hard":
+        nearest = np.where(w >= 0.5, idx_a, idx_b)
+        alphas[nearest, hh, ww] = 1.0
+    else:  # soft: translucent near plane over an opaque far plane
+        alphas[idx_a, hh, ww] = w
+        alphas[idx_b, hh, ww] = 1.0
+    return alphas[..., None]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--planes", type=int, nargs="+", default=[8, 16, 32])
+    ap.add_argument("--height", type=int, default=128)
+    ap.add_argument("--width", type=int, default=128)
+    ap.add_argument("--disparity-end", type=float, default=0.2)
+    args = ap.parse_args()
+
+    # full CPU forcing recipe — the env var alone is not enough, the axon
+    # TPU plugin self-registers and its first backend touch can hang on a
+    # dead tunnel (see __graft_entry__._force_virtual_cpu_mesh)
+    if __import__("os").environ.get("JAX_PLATFORMS", "") == "cpu":
+        from __graft_entry__ import _force_virtual_cpu_mesh
+
+        _force_virtual_cpu_mesh(1, fast_compile=True)
+
+    import jax.numpy as jnp
+
+    from mine_tpu.data.synthetic import _intrinsics, _render_view
+    from mine_tpu.inference.trajectory import poses_from_offsets
+    from mine_tpu.inference.video import render_many
+
+    h, w = args.height, args.width
+    k = _intrinsics(h, w)
+    poses = jnp.asarray(poses_from_offsets(NOVEL_OFFSETS))
+
+    for s in args.planes:
+        # use_alpha: the render path then composites the 4th channel as
+        # alpha directly (mpi_rendering.py:7-20 dispatch), which is what
+        # the oracle constructs
+        cfg = build_cfg(h, w, batch=1, num_planes=s,
+                        disparity_end=args.disparity_end)
+        cfg = cfg.replace(**{"mpi.use_alpha": True})
+        disp_planes = np.linspace(1.0, args.disparity_end, s).astype(np.float32)
+        disparity = jnp.asarray(disp_planes)[None]
+
+        for variant in ("soft", "hard"):
+            scores, src_scores = [], []
+            for ph in EVAL_PHASES:
+                src_img, src_depth = _render_view(h, w, k, np.zeros(3), ph)
+                alphas = oracle_alphas(src_depth, disp_planes, variant)
+                mpi_rgb = jnp.asarray(
+                    np.broadcast_to(src_img[None], (s,) + src_img.shape)
+                )[None]
+                mpi_sigma = jnp.asarray(alphas)[None]
+
+                # sanity: identity pose must reproduce the src image
+                ident = jnp.asarray(poses_from_offsets(np.zeros((1, 3))))
+                rgb0, _ = render_many(cfg, mpi_rgb, mpi_sigma, disparity,
+                                      jnp.asarray(k)[None], ident)
+                src_scores.append(psnr(np.asarray(rgb0)[0, CROP:-CROP, CROP:-CROP],
+                                       src_img[CROP:-CROP, CROP:-CROP]))
+
+                rgb, _ = render_many(cfg, mpi_rgb, mpi_sigma, disparity,
+                                     jnp.asarray(k)[None], poses)
+                rgb = np.asarray(rgb)
+                for i, offset in enumerate(NOVEL_OFFSETS):
+                    want, _ = _render_view(h, w, k, -offset, ph)
+                    scores.append(psnr(rgb[i, CROP:-CROP, CROP:-CROP],
+                                       want[CROP:-CROP, CROP:-CROP]))
+            print(json.dumps({
+                "metric": "oracle_mpi_novel_psnr",
+                "planes": s,
+                "variant": variant,
+                "disparity_end": args.disparity_end,
+                "psnr_novel": round(float(np.mean(scores)), 3),
+                "psnr_src_pose": round(float(np.mean(src_scores)), 3),
+                "n_eval_scenes": len(EVAL_PHASES),
+                "n_poses": len(NOVEL_OFFSETS),
+            }))
+
+
+if __name__ == "__main__":
+    main()
