@@ -86,12 +86,21 @@ def _sample_points(
 
 
 def write_colmap_scene(
-    root: str, scene: str, n_views: int = 4, hw: tuple[int, int] = (64, 64)
+    root: str,
+    scene: str,
+    n_views: int = 4,
+    hw: tuple[int, int] = (64, 64),
+    n_val_views: int = 0,
+    phase: float = 0.3,
 ) -> list[np.ndarray]:
     """Write the analytic scene to disk in LLFF/COLMAP layout (images/ +
-    sparse/0 binary model), for fixtures and loader benchmarks. Camera i sits
-    at [0.06i, 0.02i, 0] with identity rotation; every 3D point is tracked in
-    every view. Returns the camera positions."""
+    sparse/0 binary model), for fixtures, loader benchmarks, and end-to-end
+    quality runs. Camera i sits at [0.06i, 0.02i, 0] with identity rotation;
+    every 3D point is tracked in every view. With n_val_views > 0, extra
+    held-out cameras (offset half a baseline step from the train line, so no
+    val pose equals a train pose) land in images_val/ — the `<folder>_val`
+    layout LLFFDataset's val split reads (llff.py:149-150); all poses live
+    in the one sparse/0 model. Returns the train camera positions."""
     import os
 
     from PIL import Image
@@ -103,6 +112,8 @@ def write_colmap_scene(
     scene_dir = os.path.join(root, scene)
     os.makedirs(os.path.join(scene_dir, "sparse/0"), exist_ok=True)
     os.makedirs(os.path.join(scene_dir, "images"), exist_ok=True)
+    if n_val_views:
+        os.makedirs(os.path.join(scene_dir, "images_val"), exist_ok=True)
 
     rng = np.random.default_rng(0)
     world_pts = _sample_points(rng, 80, np.zeros(3))  # camera-0 frame == world
@@ -116,19 +127,23 @@ def write_colmap_scene(
                                 np.array([k[0, 0], k[0, 2], k[1, 2], 0.0]))}
     images = {}
     positions = []
-    for i in range(n_views):
-        pos = np.array([0.06 * i, 0.02 * i, 0.0])
-        positions.append(pos)
-        img, _ = _render_view(h, w, k, pos, phase=0.3)
-        name = f"view_{i:03d}.png"
+    views = [(f"view_{i:03d}.png", np.array([0.06 * i, 0.02 * i, 0.0]), "images")
+             for i in range(n_views)]
+    views += [(f"val_{j:03d}.png",
+               np.array([0.06 * j + 0.03, 0.02 * j + 0.01, 0.0]), "images_val")
+              for j in range(n_val_views)]
+    for img_id, (name, pos, folder) in enumerate(views, start=1):
+        if folder == "images":
+            positions.append(pos)
+        img, _ = _render_view(h, w, k, pos, phase=phase)
         Image.fromarray((img * 255).astype(np.uint8)).save(
-            os.path.join(scene_dir, "images", name)
+            os.path.join(scene_dir, folder, name)
         )
         # G_cam_world = [I | -pos]; all points tracked in every view
         uvw = (world_pts - pos) @ k.T
         xys = uvw[:, :2] / uvw[:, 2:]
-        images[i + 1] = colmap.ImageMeta(
-            i + 1, np.array([1.0, 0, 0, 0]), (-pos).astype(np.float64), 1, name,
+        images[img_id] = colmap.ImageMeta(
+            img_id, np.array([1.0, 0, 0, 0]), (-pos).astype(np.float64), 1, name,
             xys.astype(np.float64), np.arange(1, len(world_pts) + 1, dtype=np.int64),
         )
 

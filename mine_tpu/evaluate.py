@@ -29,6 +29,10 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
     )
     args = parser.parse_args(argv)
 
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
     import os
 
     import jax

@@ -44,6 +44,10 @@ def main(argv: list[str] | None = None) -> list[str]:
     )
     args = parser.parse_args(argv)
 
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
     from mine_tpu.inference import load_video_generator
 
     generator = load_video_generator(

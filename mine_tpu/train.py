@@ -59,6 +59,13 @@ def main(argv: list[str] | None = None) -> None:
     )
     args = parser.parse_args(argv)
 
+    # JAX_PLATFORMS=cpu must actually mean CPU even when an accelerator
+    # plugin self-registers (and could hang on a dead device) — no-op
+    # otherwise; must precede any backend touch
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
     # init_multihost must run before any backend-touching call; Trainer does
     # it first thing, so config parsing is the only work before this point.
     from mine_tpu.config import load_config

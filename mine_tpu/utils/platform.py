@@ -1,0 +1,77 @@
+"""Backend-platform forcing that actually sticks.
+
+Some PJRT plugins self-register at import time regardless of JAX_PLATFORMS
+(the axon TPU plugin in this image does), so the env var alone can leave
+the first backend touch initializing — or hanging on — an accelerator the
+user explicitly opted out of. The fix is the full recipe: env vars + the
+in-process jax.config update, applied BEFORE any backend touch.
+
+`force_cpu_devices(n)` is the shared core used by the driver entry points
+(__graft_entry__), tests/conftest.py, and the CLIs' `honor_jax_platforms()`
+guard. `fast_compile` disables LLVM's expensive optimization passes —
+compile-time over run-time, for correctness gates only, never benches.
+"""
+
+from __future__ import annotations
+
+import os
+
+from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+
+def force_cpu_devices(
+    n_devices: int,
+    compilation_cache: bool = True,
+    fast_compile: bool = False,
+) -> None:
+    """Force an n-device virtual CPU backend before any JAX backend touch.
+
+    Must run in a process where no JAX backend has been touched yet (both
+    XLA_FLAGS and jax_platforms are consumed at backend init and silently
+    ignored afterwards); raises RuntimeError otherwise instead of letting
+    the caller crash later on a confusing mesh-size error.
+    """
+    # Replace (not just append) any preset device-count flag: a preset value
+    # != n_devices would win and make_mesh(n) would fail.
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    if fast_compile:
+        flags.append("--xla_llvm_disable_expensive_passes=true")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if compilation_cache:
+        enable_persistent_compile_cache()
+    devices = jax.devices()
+    if len(devices) != n_devices or devices[0].platform != "cpu":
+        raise RuntimeError(
+            f"virtual CPU mesh forcing was a no-op: got {len(devices)} "
+            f"{devices[0].platform} device(s), wanted {n_devices} cpu. The "
+            "JAX backend was already initialized in this process — force "
+            "the platform in a fresh process."
+        )
+
+
+def honor_jax_platforms() -> None:
+    """CLI-entry guard: make `JAX_PLATFORMS=cpu` mean what it says.
+
+    Called first thing by the train/evaluate/infer CLIs. Without it, a
+    self-registering accelerator plugin can initialize (or hang on) its
+    backend even though the user asked for CPU. A no-op for any other
+    JAX_PLATFORMS value, and preserves a caller-set virtual device count.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    preset = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if f.startswith("--xla_force_host_platform_device_count=")
+    ]
+    n = int(preset[-1].split("=")[1]) if preset else 1
+    force_cpu_devices(n)
