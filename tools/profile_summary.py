@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Summarize a jax.profiler trace dir into a top-op cost table.
+
+Offline (stdlib-only) reader for the Chrome-trace JSON that
+`jax.profiler.start_trace` writes under
+`<dir>/plugins/profile/<run>/*.trace.json.gz` — no tensorboard profile
+plugin needed, which matters in this no-egress image. Feed it the
+BENCH_PROFILE_DIR a bench run captured (bench.py) or a training
+`--profile-steps` workspace profile.
+
+  python tools/profile_summary.py profiles_r04 [--top 15]
+
+Prints one JSON line per op group (fused-op name, total ms, % of device
+time, call count), device-derived rows only (TensorCore/SparseCore pids),
+sorted by total duration. The table is what BASELINE.md's step-composition
+accounting quotes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def find_traces(root: str) -> list[str]:
+    pats = [
+        os.path.join(root, "**", "*.trace.json.gz"),
+        os.path.join(root, "**", "*.trace.json"),
+    ]
+    out: list[str] = []
+    for p in pats:
+        out.extend(glob.glob(p, recursive=True))
+    return sorted(out)
+
+
+def load_events(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        return json.load(fh)
+
+
+def device_pids(meta_events: list[dict]) -> dict[int, str]:
+    """pid -> process name for on-device lanes (skip python/host threads)."""
+    names = {}
+    for ev in meta_events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = ev.get("args", {}).get("name", "")
+            if any(k in name.lower() for k in ("tpu", "tensorcore", "device",
+                                               "sparsecore", "/device:")):
+                names[ev["pid"]] = name
+    return names
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+
+    traces = find_traces(args.trace_dir)
+    if not traces:
+        print(json.dumps({"error": f"no *.trace.json[.gz] under {args.trace_dir}"}))
+        sys.exit(1)
+
+    # newest run wins (bench reruns append run dirs)
+    data = load_events(traces[-1])
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    pids = device_pids(events)
+    if not pids:  # fall back: take every complete event (CPU-only traces)
+        pids = {ev["pid"]: "all" for ev in events if ev.get("ph") == "X"}
+
+    total_us = 0.0
+    by_op: dict[str, list[float]] = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in pids:
+            continue
+        dur = float(ev.get("dur", 0.0))
+        total_us += dur
+        by_op[ev.get("name", "?")].append(dur)
+
+    rows = sorted(
+        ((name, sum(durs), len(durs)) for name, durs in by_op.items()),
+        key=lambda r: -r[1],
+    )
+    print(json.dumps({
+        "trace": traces[-1],
+        "device_lanes": sorted(set(pids.values())),
+        "device_total_ms": round(total_us / 1e3, 2),
+    }))
+    for name, tot, n in rows[: args.top]:
+        print(json.dumps({
+            "op": name[:120],
+            "total_ms": round(tot / 1e3, 2),
+            "pct": round(100.0 * tot / total_us, 1) if total_us else None,
+            "calls": n,
+        }))
+
+
+if __name__ == "__main__":
+    main()
