@@ -55,3 +55,94 @@ def test_border_clamp():
     got = np.asarray(grid_sample_pixel(jnp.asarray(src), jnp.asarray(coords)))
     assert got[0, 0, 0, 0] == 0.0  # top-left corner
     assert got[0, 0, 1, 0] == 11.0  # bottom-right corner
+
+
+class TestRecipeScaleEngagement:
+    """The VERDICT r3 ask: prove scales 0-3 of the LLFF-recipe train step
+    actually engage the Pallas warp path (the kernel choice is a TRACE-time
+    decision — `grid_sample_pixel` branches on backend + `_fits_vmem(src)`
+    while tracing, so it can be audited on CPU by tracing the full train
+    step with the backend name mocked to "tpu" and spying on the dispatch
+    helpers; no chip needed)."""
+
+    @pytest.mark.slow
+    def test_all_recipe_scales_pick_resident_kernel(self, monkeypatch):
+        import jax
+
+        import mine_tpu.ops.grid_sample as gs
+        from mine_tpu.config import Config
+        from mine_tpu.data import make_synthetic_batch
+        from mine_tpu.training import (
+            build_model, init_state, make_optimizer, make_train_step,
+        )
+
+        # the bench recipe (bench.py) at ResNet-18: the warp sources depend
+        # only on (B, S, img_h, img_w), not backbone depth — 18 keeps the
+        # trace cheap on this 1-core host
+        cfg = Config().replace(**{
+            "data.name": "llff",
+            "data.img_h": 384, "data.img_w": 512,
+            "data.per_gpu_batch_size": 2,
+            "mpi.num_bins_coarse": 32,
+            "model.num_layers": 18,
+            "loss.smoothness_gmin": 0.8,
+            "loss.smoothness_grad_ratio": 0.2,
+        })
+        model = build_model(cfg)
+        tx = make_optimizer(cfg, steps_per_epoch=10)
+        state_shape = jax.eval_shape(
+            lambda: init_state(cfg, model, tx, jax.random.PRNGKey(0))
+        )
+        batch_np = make_synthetic_batch(2, 384, 512, n_points=64, seed=0)
+        batch_shape = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+            for k, v in batch_np.items() if k != "src_depth"
+        }
+
+        picked_fwd, picked_grad = [], []
+        real_fwd, real_grad = gs._warp_fwd_fn, gs._warp_grad_fn
+
+        def spy_fwd(src):
+            fn = real_fwd(src)
+            picked_fwd.append((tuple(src.shape[1:3]), fn.__name__))
+            return fn
+
+        def spy_grad(src):
+            fn = real_grad(src)
+            picked_grad.append((tuple(src.shape[1:3]), fn.__name__))
+            return fn
+
+        monkeypatch.setattr(gs, "_warp_fwd_fn", spy_fwd)
+        monkeypatch.setattr(gs, "_warp_grad_fn", spy_grad)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        # dispatch under KNOWN conditions: the operator safety valves must
+        # not leak in from the environment
+        monkeypatch.delenv("MINE_TPU_DISABLE_PALLAS_WARP", raising=False)
+        monkeypatch.delenv("MINE_TPU_DISABLE_BANDED_WARP", raising=False)
+
+        jax.make_jaxpr(make_train_step(cfg, model, tx))(state_shape, batch_shape)
+
+        # all four loss scales warp, and every one picks the RESIDENT kernel
+        # (each per-scale source fits the 8 MB VMEM budget at 384x512)
+        fwd_shapes = {s for s, _ in picked_fwd}
+        assert {(384, 512), (192, 256), (96, 128), (48, 64)} <= fwd_shapes
+        assert picked_fwd and all(n == "warp_bilinear_chw" for _, n in picked_fwd)
+        # the backward trace selects the matching scatter kernel per scale
+        grad_shapes = {s for s, _ in picked_grad}
+        assert {(384, 512), (192, 256), (96, 128), (48, 64)} <= grad_shapes
+        assert picked_grad and all(
+            n == "warp_bilinear_grad_chw" for _, n in picked_grad
+        )
+
+    def test_full_res_shape_picks_banded_kernel(self):
+        import jax
+        import jax.numpy as jnp
+
+        import mine_tpu.ops.grid_sample as gs
+
+        # the 1008x756 stretch shape (BASELINE.md): 21.8 MB fp32 source is
+        # beyond the VMEM budget, so dispatch must select the DMA-banded
+        # variants, not fall back to the XLA gather
+        big = jax.ShapeDtypeStruct((32, 756, 1008, 7), jnp.float32)
+        assert gs._warp_fwd_fn(big).__name__ == "warp_bilinear_chw_banded"
+        assert gs._warp_grad_fn(big).__name__ == "warp_bilinear_grad_chw_banded"
