@@ -24,3 +24,18 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+CONFIGS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "mine_tpu", "configs"
+)
+
+
+def load_shipped_config(*names, **kw):
+    """Layer shipped recipe yamls by bare name ('default', 'llff', ...)
+    through the same load_config path the training CLI uses."""
+    from mine_tpu.config import load_config
+
+    return load_config(
+        *(os.path.join(CONFIGS_DIR, n + ".yaml") for n in names), **kw
+    )

@@ -13,11 +13,8 @@ from mine_tpu.config import (
     to_flat_dict,
 )
 
-CONFIGS = os.path.join(os.path.dirname(__file__), "..", "mine_tpu", "configs")
-
-
-def _cfg(*names, **kw):
-    return load_config(*(os.path.join(CONFIGS, n + ".yaml") for n in names), **kw)
+from conftest import CONFIGS_DIR as CONFIGS
+from conftest import load_shipped_config as _cfg
 
 
 def test_default_yaml_round_trips_defaults():
@@ -26,7 +23,8 @@ def test_default_yaml_round_trips_defaults():
 
 
 @pytest.mark.parametrize(
-    "name", ["llff", "nocs_llff", "objectron", "realestate", "kitti_raw", "flowers", "dtu"]
+    "name", ["llff", "llff_highres", "nocs_llff", "objectron", "realestate",
+             "kitti_raw", "flowers", "dtu"]
 )
 def test_all_dataset_configs_load(name):
     cfg = _cfg("default", name)

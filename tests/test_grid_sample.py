@@ -146,3 +146,24 @@ class TestRecipeScaleEngagement:
         big = jax.ShapeDtypeStruct((32, 756, 1008, 7), jnp.float32)
         assert gs._warp_fwd_fn(big).__name__ == "warp_bilinear_chw_banded"
         assert gs._warp_grad_fn(big).__name__ == "warp_bilinear_grad_chw_banded"
+
+    def test_highres_recipe_engages_banded_kernel(self):
+        import jax
+        import jax.numpy as jnp
+
+        from conftest import load_shipped_config
+
+        import mine_tpu.ops.grid_sample as gs
+
+        # the shipped stretch recipe must land on the banded kernels, and
+        # must ship with the decoder rematerialized (S=128 at 1024x768
+        # does not fit HBM otherwise)
+        cfg = load_shipped_config("default", "llff_highres")
+        assert cfg.model.remat_decoder
+        src = jax.ShapeDtypeStruct(
+            (cfg.data.per_gpu_batch_size * cfg.mpi.num_bins_coarse,
+             cfg.data.img_h, cfg.data.img_w, 7),
+            jnp.float32,
+        )
+        assert gs._warp_fwd_fn(src).__name__ == "warp_bilinear_chw_banded"
+        assert gs._warp_grad_fn(src).__name__ == "warp_bilinear_grad_chw_banded"
