@@ -19,6 +19,7 @@ from mine_tpu.ops.grid_sample import grid_sample_pixel
 from mine_tpu.ops.homography import (
     build_plane_homography,
     homography_sample,
+    homography_sample_coords,
 )
 from mine_tpu.ops.mpi_render import (
     Compositor,

@@ -51,29 +51,35 @@ def build_plane_homography(
     return jnp.einsum("bij,bjk,bkl->bil", k_tgt, r_tnd, k_src_inv, precision=_PRECISION)
 
 
-def homography_sample(
-    src: Array,
+def homography_sample_coords(
     plane_depth: Array,
     g_tgt_src: Array,
     k_src_inv: Array,
     k_tgt: Array,
+    h_src: int,
+    w_src: int,
     tgt_height: int | None = None,
     tgt_width: int | None = None,
 ) -> tuple[Array, Array]:
-    """Warp source-frame plane images into the target camera.
+    """Source-pixel sample locations for every target pixel, plus validity.
+
+    The coordinate half of the warp (reference homography_sampler.py:110-141),
+    exposed separately so callers can evaluate closed-form per-plane fields
+    (e.g. plane xyz, affine in pixel coords) directly at the sample locations
+    instead of paying gather bandwidth for them — see
+    mpi_render.warp_mpi_to_tgt.
 
     Args:
-      src: (B, H, W, C) per-plane source images (B may be batch*planes).
-      plane_depth: (B,) plane depths in the source frame.
+      plane_depth: (B,) plane depths in the source frame (B may be B*S).
       g_tgt_src, k_src_inv, k_tgt: camera parameters, (B, 4, 4) / (B, 3, 3).
+      h_src/w_src: source resolution (bounds the validity test).
       tgt_height/tgt_width: target resolution (defaults to source).
     Returns:
-      warped: (B, Ht, Wt, C);
+      src_xy: (B, Ht, Wt, 2) fp32 sample locations in source pixel units;
       valid:  (B, Ht, Wt) bool mask of target pixels that land inside the
               source FoV (reference homography_sampler.py:137-141 uses the
               open interval (-1, W) x (-1, H)).
     """
-    b, h_src, w_src, _ = src.shape
     h_tgt = tgt_height or h_src
     w_tgt = tgt_width or w_src
 
@@ -102,6 +108,34 @@ def homography_sample(
         & (src_xy[..., 0] < w_src)
         & (src_xy[..., 1] > -1.0)
         & (src_xy[..., 1] < h_src)
+    )
+    return src_xy, valid
+
+
+def homography_sample(
+    src: Array,
+    plane_depth: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    tgt_height: int | None = None,
+    tgt_width: int | None = None,
+) -> tuple[Array, Array]:
+    """Warp source-frame plane images into the target camera.
+
+    Args:
+      src: (B, H, W, C) per-plane source images (B may be batch*planes).
+      plane_depth: (B,) plane depths in the source frame.
+      g_tgt_src, k_src_inv, k_tgt: camera parameters, (B, 4, 4) / (B, 3, 3).
+      tgt_height/tgt_width: target resolution (defaults to source).
+    Returns:
+      warped: (B, Ht, Wt, C);
+      valid:  (B, Ht, Wt) bool mask (see homography_sample_coords).
+    """
+    b, h_src, w_src, _ = src.shape
+    src_xy, valid = homography_sample_coords(
+        plane_depth, g_tgt_src, k_src_inv, k_tgt,
+        h_src, w_src, tgt_height, tgt_width,
     )
     warped = grid_sample_pixel(src, src_xy).astype(src.dtype)
     return warped, valid

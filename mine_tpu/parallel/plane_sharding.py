@@ -163,7 +163,6 @@ def sharded_render_tgt_rgb_depth(
     mpi_rgb_src: Array,
     mpi_sigma_src: Array,
     mpi_disparity_src: Array,
-    xyz_tgt: Array,
     g_tgt_src: Array,
     k_src_inv: Array,
     k_tgt: Array,
@@ -174,12 +173,12 @@ def sharded_render_tgt_rgb_depth(
     """Plane-sharded target-view render (unsharded twin:
     ops.render_tgt_rgb_depth; reference mpi_rendering.py:181-241).
 
-    The homography warp is per-plane local work and runs unchanged on each
-    device's chunk; only the composite and the in-FoV plane count cross the
-    plane axis.
+    The homography warp — including the analytic per-plane xyz evaluation —
+    is per-plane local work and runs unchanged on each device's chunk; only
+    the composite and the in-FoV plane count cross the plane axis.
     """
     tgt_rgb, tgt_sigma, tgt_xyz, valid = warp_mpi_to_tgt(
-        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src, xyz_tgt,
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
         g_tgt_src, k_src_inv, k_tgt,
     )
     tgt_rgb_syn, tgt_depth_syn, _, _ = sharded_render(
@@ -215,10 +214,10 @@ def _weighted_sum_kw(axis_name, rgb, xyz, weights, is_bg_depth_inf=False):
 
 
 def _render_tgt_kw(
-    axis_name, mpi_rgb, mpi_sigma, disparity, xyz_tgt, g, k_src_inv, k_tgt,
+    axis_name, mpi_rgb, mpi_sigma, disparity, g, k_src_inv, k_tgt,
     use_alpha=False, is_bg_depth_inf=False,
 ):
     return sharded_render_tgt_rgb_depth(
-        mpi_rgb, mpi_sigma, disparity, xyz_tgt, g, k_src_inv, k_tgt,
+        mpi_rgb, mpi_sigma, disparity, g, k_src_inv, k_tgt,
         axis_name, use_alpha, is_bg_depth_inf,
     )

@@ -201,15 +201,12 @@ def render_novel_view(
         sf = lax.stop_gradient(scale_factor)
         g_tgt_src = g_tgt_src.at[:, :3, 3].set(g_tgt_src[:, :3, 3] / sf[:, None])
 
-    h, w = mpi_rgb.shape[2], mpi_rgb.shape[3]
-    grid = ops.homogeneous_pixel_grid(h, w)
-    xyz_src = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
-    xyz_tgt = ops.get_tgt_xyz_from_plane_disparity(xyz_src, g_tgt_src)
+    # no xyz precompute: the warp evaluates per-plane xyz analytically at
+    # its own sample coords (ops/mpi_render.py warp_mpi_to_tgt)
     tgt_rgb_syn, tgt_depth_syn, tgt_mask = compositor.render_tgt_rgb_depth(
         mpi_rgb,
         mpi_sigma,
         disparity,
-        xyz_tgt,
         g_tgt_src,
         k_src_inv,
         k_tgt,

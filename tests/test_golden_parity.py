@@ -134,12 +134,14 @@ def test_render_tgt_rgb_depth_golden(ref, rng, is_bg_depth_inf):
     k_inv = np.linalg.inv(k)
     h, w = rgb.shape[2], rgb.shape[3]
 
+    # ours no longer feeds xyz through the render (the warp evaluates it
+    # analytically), but the geometry twins stay parity-pinned here
     xyz_src = ops.get_src_xyz_from_plane_disparity(
         ops.homogeneous_pixel_grid(h, w), jnp.asarray(disparity), jnp.asarray(k_inv)
     )
     xyz_tgt = ops.get_tgt_xyz_from_plane_disparity(xyz_src, jnp.asarray(g))
     got_rgb, got_depth, got_mask = ops.render_tgt_rgb_depth(
-        jnp.asarray(rgb), jnp.asarray(sigma), jnp.asarray(disparity), xyz_tgt,
+        jnp.asarray(rgb), jnp.asarray(sigma), jnp.asarray(disparity),
         jnp.asarray(g), jnp.asarray(k_inv), jnp.asarray(k),
         is_bg_depth_inf=is_bg_depth_inf,
     )

@@ -160,9 +160,12 @@ class TestRecipeScaleEngagement:
         # does not fit HBM otherwise)
         cfg = load_shipped_config("default", "llff_highres")
         assert cfg.model.remat_decoder
+        # the warp payload is 4 channels (rgb+sigma, fp32 — the decoder heads
+        # cast to fp32; plane xyz is evaluated analytically, not gathered):
+        # 1024*768*4*4B ~= 12.6 MB, still beyond the 8 MB residency budget
         src = jax.ShapeDtypeStruct(
             (cfg.data.per_gpu_batch_size * cfg.mpi.num_bins_coarse,
-             cfg.data.img_h, cfg.data.img_w, 7),
+             cfg.data.img_h, cfg.data.img_w, 4),
             jnp.float32,
         )
         assert gs._warp_fwd_fn(src).__name__ == "warp_bilinear_chw_banded"

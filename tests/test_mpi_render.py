@@ -6,7 +6,6 @@ import jax.numpy as jnp
 from mine_tpu.ops import (
     alpha_composition,
     get_src_xyz_from_plane_disparity,
-    get_tgt_xyz_from_plane_disparity,
     homogeneous_pixel_grid,
     plane_volume_rendering,
     render_tgt_rgb_depth,
@@ -118,13 +117,11 @@ def test_render_tgt_identity_pose(rng):
     xyz_src = get_src_xyz_from_plane_disparity(
         homogeneous_pixel_grid(h, w), jnp.asarray(disparity), jnp.asarray(k_inv)
     )
-    xyz_tgt = get_tgt_xyz_from_plane_disparity(xyz_src, jnp.asarray(g))
 
     tgt_rgb, tgt_depth, tgt_mask = render_tgt_rgb_depth(
         jnp.asarray(rgb),
         jnp.asarray(sigma),
         jnp.asarray(disparity),
-        xyz_tgt,
         jnp.asarray(g),
         jnp.asarray(k_inv),
         jnp.asarray(k),

@@ -120,13 +120,7 @@ def test_sharded_volume_rendering_grads_finite(rng):
 
 def test_sharded_render_tgt_matches_unsharded(rng):
     """Plane-sharded target-view warp+composite == unsharded twin."""
-    from mine_tpu.ops import (
-        get_src_xyz_from_plane_disparity,
-        get_tgt_xyz_from_plane_disparity,
-        homogeneous_pixel_grid,
-        inverse_3x3,
-        render_tgt_rgb_depth,
-    )
+    from mine_tpu.ops import inverse_3x3, render_tgt_rgb_depth
 
     b, s, h, w = 1, 8, 8, 10
     rgb = jnp.asarray(rng.uniform(size=(b, s, h, w, 3)).astype(np.float32))
@@ -140,22 +134,18 @@ def test_sharded_render_tgt_matches_unsharded(rng):
     g[:3, 3] = [0.05, -0.02, 0.01]
     g = jnp.asarray(g)[None]
 
-    xyz_src = get_src_xyz_from_plane_disparity(
-        homogeneous_pixel_grid(h, w), disparity, k_inv
-    )
-    xyz_tgt = get_tgt_xyz_from_plane_disparity(xyz_src, g)
-    want = render_tgt_rgb_depth(rgb, sigma, disparity, xyz_tgt, g, k_inv, k)
+    want = render_tgt_rgb_depth(rgb, sigma, disparity, g, k_inv, k)
 
     mesh = _plane_mesh(4)
     fn = shard_map(
-        lambda r, sg, d, x: sharded_render_tgt_rgb_depth(
-            r, sg, d, x, g, k_inv, k, "plane"
+        lambda r, sg, d: sharded_render_tgt_rgb_depth(
+            r, sg, d, g, k_inv, k, "plane"
         ),
         mesh=mesh,
-        in_specs=(P(None, "plane"), P(None, "plane"), P(None, "plane"), P(None, "plane")),
+        in_specs=(P(None, "plane"), P(None, "plane"), P(None, "plane")),
         out_specs=(P(), P(), P()),
     )
-    got = jax.jit(fn)(rgb, sigma, disparity, xyz_tgt)
+    got = jax.jit(fn)(rgb, sigma, disparity)
     for g_, w_, name in zip(got, want, ["rgb", "depth", "mask"]):
         np.testing.assert_allclose(
             np.asarray(g_), np.asarray(w_), rtol=2e-5, atol=2e-5, err_msg=name
