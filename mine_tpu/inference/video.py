@@ -163,12 +163,8 @@ def predict_blended_mpi(
     model = build_model(cfg)
     mpi = model.apply(variables, img, disparity, False)[0]
     mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
-    grid = ops.homogeneous_pixel_grid(img.shape[1], img.shape[2])
-    xyz_src = ops.get_src_xyz_from_plane_disparity(
-        grid, disparity, ops.inverse_3x3(k)
-    )
-    _, _, blend_weights, _ = ops.render(
-        mpi_rgb, mpi_sigma, xyz_src,
+    _, _, blend_weights, _ = ops.render_src(
+        mpi_rgb, mpi_sigma, disparity, ops.inverse_3x3(k),
         use_alpha=cfg.mpi.use_alpha,
         is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
     )

@@ -26,8 +26,11 @@ from mine_tpu.ops.mpi_render import (
     DENSE_COMPOSITOR,
     alpha_composition,
     plane_volume_rendering,
+    ray_norms,
     weighted_sum_mpi,
+    weighted_sum_src,
     render,
+    render_src,
     render_tgt_rgb_depth,
     warp_mpi_to_tgt,
 )

@@ -15,7 +15,7 @@ from typing import Callable, NamedTuple
 import jax.numpy as jnp
 from jax import Array
 
-from mine_tpu.ops.geometry import _PRECISION
+from mine_tpu.ops.geometry import _PRECISION, homogeneous_pixel_grid
 from mine_tpu.ops.homography import homography_sample_coords
 from mine_tpu.ops.grid_sample import grid_sample_pixel
 
@@ -103,6 +103,90 @@ def render(
     imgs_syn, weights = alpha_composition(sigma, rgb)
     depth_syn, _ = alpha_composition(sigma, xyz[..., 2:3])
     return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
+
+
+# -- source-pose fast path ---------------------------------------------------
+#
+# At the SOURCE pose the plane sweep is fronto-parallel: xyz_s(q) =
+# depth_s * K^-1 [qx, qy, 1]. The reference materializes the full
+# (B, S, H, W, 3) xyz tensor and takes norms of its plane-to-plane diffs
+# (mpi_rendering.py:42-67 fed by :140-163); but the diff factors exactly —
+#   xyz_{s+1}(q) - xyz_s(q) = (depth_{s+1} - depth_s) * K^-1 q
+#   => dist_s(q) = |d_{s+1} - d_s| * ||K^-1 q||
+# an (S,) vector times an (H, W) map — and per-plane z is the CONSTANT
+# depth_s. So source-view compositing needs no per-plane xyz at all: S x
+# less multiply work and no (B, S, H, W, 3) intermediates. Same math to ~1
+# ulp (products are rounded in a different order).
+
+
+def ray_norms(k_inv: Array, h: int, w: int) -> Array:
+    """||K^-1 [x, y, 1]|| per pixel: (B, 3, 3) -> (B, H, W, 1)."""
+    grid = homogeneous_pixel_grid(h, w, jnp.float32)
+    rays = jnp.einsum("bij,hwj->bhwi", k_inv, grid, precision=_PRECISION)
+    return jnp.linalg.norm(rays, axis=-1, keepdims=True)
+
+
+def _src_dists(mpi_disparity: Array, k_inv: Array, h: int, w: int) -> Array:
+    """Factored inter-plane distances for the source sweep:
+    (B, S) disparities -> (B, S, H, W, 1) with the background pseudo-distance
+    in the last slot (twin of the dist block in plane_volume_rendering)."""
+    depth = 1.0 / mpi_disparity  # (B, S)
+    ddiff = jnp.abs(depth[:, 1:] - depth[:, :-1])  # (B, S-1)
+    dist = ddiff[:, :, None, None, None] * ray_norms(k_inv, h, w)[:, None]
+    return jnp.concatenate(
+        [dist, jnp.full_like(dist[:, :1], _BG_DIST)], axis=1
+    )
+
+
+def weighted_sum_src(
+    rgb: Array, mpi_disparity: Array, weights: Array, is_bg_depth_inf: bool = False
+) -> tuple[Array, Array]:
+    """weighted_sum_mpi for the source sweep, where per-plane z is the
+    constant plane depth (no xyz tensor).
+
+    rgb: (B, S, H, W, 3); mpi_disparity: (B, S); weights: (B, S, H, W, 1).
+    """
+    z = (1.0 / mpi_disparity)[:, :, None, None, None]  # (B, S, 1, 1, 1)
+    weights_sum = jnp.sum(weights, axis=1)
+    rgb_out = jnp.sum(weights * rgb, axis=1)
+    if is_bg_depth_inf:
+        depth_out = jnp.sum(weights * z, axis=1) + (1.0 - weights_sum) * 1000.0
+    else:
+        depth_out = jnp.sum(weights * z, axis=1) / (weights_sum + 1.0e-5)
+    return rgb_out, depth_out
+
+
+def render_src(
+    rgb: Array,
+    sigma: Array,
+    mpi_disparity: Array,
+    k_inv: Array,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """`render` at the source pose, from disparities + intrinsics alone.
+
+    rgb: (B, S, H, W, 3); sigma: (B, S, H, W, 1); mpi_disparity: (B, S);
+    k_inv: (B, 3, 3). Returns (imgs_syn, depth_syn, blend_weights, weights)
+    exactly like `render`.
+    """
+    h, w = rgb.shape[2], rgb.shape[3]
+    if use_alpha:
+        imgs_syn, weights = alpha_composition(sigma, rgb)
+        z = jnp.broadcast_to(
+            (1.0 / mpi_disparity)[:, :, None, None, None],
+            sigma.shape,
+        )
+        depth_syn, _ = alpha_composition(sigma, z)
+        return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
+
+    dist = _src_dists(mpi_disparity, k_inv, h, w)
+    transparency = jnp.exp(-sigma * dist)
+    alpha = 1.0 - transparency
+    transparency_acc = _shifted_exclusive(jnp.cumprod(transparency + 1.0e-6, axis=1))
+    weights = transparency_acc * alpha
+    rgb_out, depth_out = weighted_sum_src(rgb, mpi_disparity, weights, is_bg_depth_inf)
+    return rgb_out, depth_out, transparency_acc, weights
 
 
 def warp_mpi_to_tgt(
@@ -217,9 +301,9 @@ class Compositor(NamedTuple):
     the loss graph itself is oblivious (SURVEY.md §5.7).
     """
 
-    render: Callable
-    weighted_sum_mpi: Callable
+    render_src: Callable
+    weighted_sum_src: Callable
     render_tgt_rgb_depth: Callable
 
 
-DENSE_COMPOSITOR = Compositor(render, weighted_sum_mpi, render_tgt_rgb_depth)
+DENSE_COMPOSITOR = Compositor(render_src, weighted_sum_src, render_tgt_rgb_depth)

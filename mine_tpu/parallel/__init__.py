@@ -20,6 +20,8 @@ from mine_tpu.parallel.plane_sharding import (
     sharded_alpha_composition,
     sharded_plane_volume_rendering,
     sharded_render,
+    sharded_render_src,
     sharded_render_tgt_rgb_depth,
     sharded_weighted_sum_mpi,
+    sharded_weighted_sum_src,
 )

@@ -28,6 +28,7 @@ from mine_tpu.ops.mpi_render import (
     _BG_DIST,
     Compositor,
     _shifted_exclusive,
+    ray_norms,
     warp_mpi_to_tgt,
 )
 
@@ -159,6 +160,79 @@ def sharded_render(
     return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
 
 
+def sharded_render_src(
+    rgb: Array,
+    sigma: Array,
+    mpi_disparity: Array,
+    k_inv: Array,
+    axis_name: str,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array, Array, Array]:
+    """Plane-sharded source-pose compositing from local disparities alone
+    (unsharded twin: ops.render_src — see its factored-distance derivation).
+
+    mpi_disparity: (B, S_local) this device's plane chunk. The inter-plane
+    distance at the chunk boundary needs only the NEXT device's first plane
+    DEPTH — a (B,) halo instead of the (B, H, W, 3) xyz halo the generic
+    sharded path ships.
+    """
+    if use_alpha:
+        imgs_syn, weights = sharded_alpha_composition(sigma, rgb, axis_name)
+        z = jnp.broadcast_to(
+            (1.0 / mpi_disparity)[:, :, None, None, None], sigma.shape
+        )
+        depth_syn, _ = sharded_alpha_composition(sigma, z, axis_name)
+        return imgs_syn, depth_syn, jnp.zeros_like(rgb), weights
+
+    h, w = rgb.shape[2], rgb.shape[3]
+    depth = 1.0 / mpi_disparity  # (B, S_local)
+    depth_next = _halo_next_first_plane(
+        depth[:, :, None], axis_name, depth[:, -1:]
+    )  # (B, 1); fill value unused (overwritten by the bg distance below)
+    depth_ext = jnp.concatenate([depth, depth_next], axis=1)  # (B, S_local+1)
+    ddiff = jnp.abs(depth_ext[:, 1:] - depth_ext[:, :-1])  # (B, S_local)
+
+    dist = ddiff[:, :, None, None, None] * ray_norms(k_inv, h, w)[:, None]
+    n = lax.axis_size(axis_name)
+    s_local = ddiff.shape[1]
+    last_mask = (jnp.arange(s_local) == s_local - 1).reshape(1, s_local, 1, 1, 1)
+    bg_mask = jnp.logical_and(lax.axis_index(axis_name) == n - 1, last_mask)
+    dist = jnp.where(bg_mask, _BG_DIST, dist)
+
+    transparency = jnp.exp(-sigma * dist)
+    alpha = 1.0 - transparency
+    trans_local = jnp.cumprod(transparency + 1.0e-6, axis=1)
+    prefix = _exclusive_device_prefix(trans_local[:, -1], axis_name)
+    transparency_acc = _shifted_exclusive(trans_local) * prefix[:, None]
+    weights = transparency_acc * alpha
+
+    rgb_out, depth_out = sharded_weighted_sum_src(
+        rgb, mpi_disparity, weights, axis_name, is_bg_depth_inf
+    )
+    return rgb_out, depth_out, transparency_acc, weights
+
+
+def sharded_weighted_sum_src(
+    rgb: Array,
+    mpi_disparity: Array,
+    weights: Array,
+    axis_name: str,
+    is_bg_depth_inf: bool = False,
+) -> tuple[Array, Array]:
+    """Plane-sharded weighted_sum_src: per-plane z is the constant local
+    plane depth (unsharded twin: ops.weighted_sum_src)."""
+    z = (1.0 / mpi_disparity)[:, :, None, None, None]
+    weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
+    rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)
+    z_term = lax.psum(jnp.sum(weights * z, axis=1), axis_name)
+    if is_bg_depth_inf:
+        depth_out = z_term + (1.0 - weights_sum) * 1000.0
+    else:
+        depth_out = z_term / (weights_sum + 1.0e-5)
+    return rgb_out, depth_out
+
+
 def sharded_render_tgt_rgb_depth(
     mpi_rgb_src: Array,
     mpi_sigma_src: Array,
@@ -197,20 +271,26 @@ def plane_compositor(axis_name: str) -> Compositor:
     is the whole difference between the unsharded and plane-parallel loss
     graphs (training/step.py)."""
     return Compositor(
-        render=partial(_render_kw, axis_name),
-        weighted_sum_mpi=partial(_weighted_sum_kw, axis_name),
+        render_src=partial(_render_src_kw, axis_name),
+        weighted_sum_src=partial(_weighted_sum_src_kw, axis_name),
         render_tgt_rgb_depth=partial(_render_tgt_kw, axis_name),
     )
 
 
 # keyword-compatible adapters: the loss graph calls the Compositor fields with
 # the unsharded ops' signatures (use_alpha=..., is_bg_depth_inf=...)
-def _render_kw(axis_name, rgb, sigma, xyz, use_alpha=False, is_bg_depth_inf=False):
-    return sharded_render(rgb, sigma, xyz, axis_name, use_alpha, is_bg_depth_inf)
+def _render_src_kw(
+    axis_name, rgb, sigma, disparity, k_inv, use_alpha=False, is_bg_depth_inf=False
+):
+    return sharded_render_src(
+        rgb, sigma, disparity, k_inv, axis_name, use_alpha, is_bg_depth_inf
+    )
 
 
-def _weighted_sum_kw(axis_name, rgb, xyz, weights, is_bg_depth_inf=False):
-    return sharded_weighted_sum_mpi(rgb, xyz, weights, axis_name, is_bg_depth_inf)
+def _weighted_sum_src_kw(axis_name, rgb, disparity, weights, is_bg_depth_inf=False):
+    return sharded_weighted_sum_src(
+        rgb, disparity, weights, axis_name, is_bg_depth_inf
+    )
 
 
 def _render_tgt_kw(

@@ -261,18 +261,19 @@ def loss_fcn_per_scale(
     mpi_rgb = mpi[..., 0:3]
     mpi_sigma = mpi[..., 3:4]
 
-    grid = ops.homogeneous_pixel_grid(src_img.shape[1], src_img.shape[2])
-    xyz_src = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
-    src_syn, src_depth, blend_weights, weights = compositor.render(
-        mpi_rgb, mpi_sigma, xyz_src,
+    # the source sweep is fronto-parallel, so compositing needs only the
+    # disparity list + intrinsics — no (B, S, H, W, 3) xyz tensor
+    # (ops/mpi_render.py render_src)
+    src_syn, src_depth, blend_weights, weights = compositor.render_src(
+        mpi_rgb, mpi_sigma, disparity, k_src_inv,
         use_alpha=cfg.mpi.use_alpha, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
     )
     if cfg.training.src_rgb_blending:
         # visible-from-src parts take the real pixels; occluded parts keep the
         # network's rgb (synthesis_task.py:282-290)
         mpi_rgb = blend_weights * src_img[:, None] + (1.0 - blend_weights) * mpi_rgb
-        src_syn, src_depth = compositor.weighted_sum_mpi(
-            mpi_rgb, xyz_src, weights, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf
+        src_syn, src_depth = compositor.weighted_sum_src(
+            mpi_rgb, disparity, weights, is_bg_depth_inf=cfg.mpi.is_bg_depth_inf
         )
     src_disparity_syn = 1.0 / src_depth
 

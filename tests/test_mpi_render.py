@@ -1,6 +1,7 @@
 """Compositing correctness vs brute-force loops and closed-form cases."""
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from mine_tpu.ops import (
@@ -132,3 +133,53 @@ def test_render_tgt_identity_pose(rng):
     np.testing.assert_allclose(np.asarray(tgt_rgb), np.asarray(src_rgb), atol=1e-4)
     np.testing.assert_allclose(np.asarray(tgt_depth), np.asarray(src_depth), rtol=1e-3, atol=1e-4)
     np.testing.assert_allclose(np.asarray(tgt_mask), s, atol=1e-6)
+
+
+class TestSrcFastPath:
+    """render_src / weighted_sum_src: the factored source-sweep compositing
+    (dist = |d(s+1) - d(s)| * ||K^-1 q||; per-plane z = plane depth) must
+    match the generic xyz-materializing path to fp-reassociation tolerance."""
+
+    def _scene(self, rng, b=2, s=6, h=4, w=5):
+        from mine_tpu.ops import inverse_3x3
+
+        rgb = rng.uniform(0, 1, (b, s, h, w, 3)).astype(np.float32)
+        sigma = rng.uniform(0, 3, (b, s, h, w, 1)).astype(np.float32)
+        k = np.array(
+            [[8.0, 0, 2.5], [0, 8.0, 2.0], [0, 0, 1.0]], dtype=np.float32
+        )[None].repeat(b, 0)
+        disparity = np.linspace(1.0, 0.1, s, dtype=np.float32)[None].repeat(b, 0)
+        k_inv = inverse_3x3(jnp.asarray(k))
+        xyz = get_src_xyz_from_plane_disparity(
+            homogeneous_pixel_grid(h, w), jnp.asarray(disparity), k_inv
+        )
+        return (jnp.asarray(rgb), jnp.asarray(sigma), jnp.asarray(disparity),
+                k_inv, xyz)
+
+    @pytest.mark.parametrize("use_alpha", [False, True])
+    @pytest.mark.parametrize("is_bg_depth_inf", [False, True])
+    def test_render_src_matches_generic(self, rng, use_alpha, is_bg_depth_inf):
+        from mine_tpu.ops import render, render_src
+
+        rgb, sigma, disparity, k_inv, xyz = self._scene(rng)
+        want = render(rgb, sigma, xyz, use_alpha, is_bg_depth_inf)
+        got = render_src(rgb, sigma, disparity, k_inv, use_alpha, is_bg_depth_inf)
+        for g, w_, name in zip(got, want, ["rgb", "depth", "blend", "weights"]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w_), rtol=1e-4, atol=1e-5, err_msg=name
+            )
+
+    @pytest.mark.parametrize("is_bg_depth_inf", [False, True])
+    def test_weighted_sum_src_matches_generic(self, rng, is_bg_depth_inf):
+        from mine_tpu.ops import weighted_sum_mpi, weighted_sum_src
+
+        rgb, sigma, disparity, k_inv, xyz = self._scene(rng)
+        weights = jnp.asarray(
+            rng.uniform(0, 0.3, rgb.shape[:4] + (1,)).astype(np.float32)
+        )
+        want = weighted_sum_mpi(rgb, xyz, weights, is_bg_depth_inf)
+        got = weighted_sum_src(rgb, disparity, weights, is_bg_depth_inf)
+        for g, w_, name in zip(got, want, ["rgb", "depth"]):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w_), rtol=1e-4, atol=1e-5, err_msg=name
+            )

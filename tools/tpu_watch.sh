@@ -65,10 +65,20 @@ while true; do
             BENCH_WIDTH_MULTIPLE=64 BENCH_SECOND_POINT=0 timeout 3600 \
                 python bench.py >bench_r04_width64.json 2>bench_r04_width64.err
             echo "$(date -u +%H:%M:%S) stage 4 rc=$?" >&2
+            alive || { sleep "$INTERVAL"; continue; }
+        fi
+        # the post-refactor hot shape: rgb+sigma only (analytic xyz), C=4
+        if ! good bench_warp_384c4_r04.json '"warp_grad_resident"'; then
+            echo "$(date -u +%H:%M:%S) stage 5: bench_warp C=4 hot shape" >&2
+            timeout 1800 python tools/bench_warp.py \
+                --n 64 --h 384 --w 512 --c 4 --mode resident --grad \
+                >bench_warp_384c4_r04.json 2>bench_warp_384c4_r04.err
+            echo "$(date -u +%H:%M:%S) stage 5 rc=$?" >&2
         fi
         if good bench_r04_tpu.json '"value"' \
             && good bench_warp_r04.json '"warp_grad_banded"' \
             && good bench_warp_384_r04.json '"warp_fwd_xla"' \
+            && good bench_warp_384c4_r04.json '"warp_grad_resident"' \
             && good bench_r04_width64.json '"value"'; then
             echo "$(date -u +%H:%M:%S) all stages complete" >&2
             exit 0
