@@ -16,7 +16,9 @@ comparison (>=4x PyTorch-V100, BASELINE.md) awaits a measured V100 number.
 
 Prints exactly one JSON line:
   {"metric", "value", "unit", "vs_baseline", "flops_per_step",
-   "model_tflops_per_sec", "mfu", "device", "note"}
+   "model_tflops_per_sec", "mfu", "step_ms", "mosaic_kernel_calls",
+   "width_multiple", "device", "note"} plus *_b8 twins for the optional
+  second point; on failure {"metric", "value": null, "error", "note"}.
 """
 
 from __future__ import annotations
@@ -94,6 +96,23 @@ def executable_flops(compiled) -> float | None:
             cost = cost[0]
         flops = cost.get("flops")
         return float(flops) if flops and flops > 0 else None
+    except Exception:  # pragma: no cover - backend-dependent surface
+        return None
+
+
+def mosaic_kernel_calls(compiled) -> int | None:
+    """How many Mosaic (Pallas) custom-calls the compiled step contains.
+
+    Puts kernel ENGAGEMENT in the measured artifact itself: the 4-scale
+    step should show one warp gather per scale in the forward and one
+    scatter per scale in the backward (>= 8; coordinate-cotangent
+    re-gathers may add more if XLA keeps them alive). 0 on this workload
+    means the step silently fell back to XLA's ~100x-off gather
+    (BASELINE.md r3) and the throughput number should be read
+    accordingly."""
+    try:
+        hlo = compiled.as_text()
+        return hlo.count('custom_call_target="tpu_custom_call"')
     except Exception:  # pragma: no cover - backend-dependent surface
         return None
 
@@ -220,6 +239,7 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
         ),
         "mfu": mfu,
         "step_ms": round(elapsed / MEASURE_STEPS * 1e3, 1),
+        "mosaic_kernel_calls": mosaic_kernel_calls(compiled),
         "remat": remat_used,
         "width_multiple": width_multiple,
         "device": device.device_kind,
@@ -240,15 +260,17 @@ def _run() -> None:
         "model_tflops_per_sec": primary["model_tflops_per_sec"],
         "mfu": primary["mfu"],
         "step_ms": primary["step_ms"],
+        "mosaic_kernel_calls": primary["mosaic_kernel_calls"],
         "width_multiple": primary["width_multiple"],
         "device": primary["device"],
         "note": (
-            "vs_baseline awaits a measured reference denominator (the "
-            "reference repo publishes no throughput, SURVEY.md §6); mfu = "
-            "XLA cost-analysis FLOPs / published chip peak; B=2 is the "
-            "reference recipe's per-GPU batch (params_llff.yaml), not a "
-            "TPU constraint — see the b8 fields for the hardware-friendly "
-            "point"
+            "vs_baseline awaits a reference denominator on comparable "
+            "hardware (the reference repo publishes no throughput, SURVEY.md "
+            "§6; the only measured head-to-head is same-host CPU: ours 1.44x "
+            "the reference torch step, BASELINE.md r4); mfu = XLA "
+            "cost-analysis FLOPs / published chip peak; B=2 is the reference "
+            "recipe's per-GPU batch (params_llff.yaml), not a TPU constraint "
+            "— see the b8 fields for the hardware-friendly point"
         ),
     }
 
@@ -266,6 +288,7 @@ def _run() -> None:
             result["step_ms_b8"] = b8["step_ms"]
             result["flops_per_step_b8"] = b8["flops_per_step"]
             result["remat_b8"] = b8["remat"]
+            result["mosaic_kernel_calls_b8"] = b8["mosaic_kernel_calls"]
         except Exception as e:  # noqa: BLE001 - the primary number stands alone
             print(f"# B=8 point failed: {e}", file=sys.stderr)
             result["b8_error"] = f"{type(e).__name__}: {e}"[:500]
