@@ -1,6 +1,10 @@
 """Small-tool coverage: LLFF resize tool + multi-host bootstrap branches."""
 
+import gzip
+import json
 import os
+import subprocess
+import sys
 import warnings
 
 import numpy as np
@@ -9,6 +13,8 @@ from PIL import Image
 
 from mine_tpu.parallel import init_multihost
 from tools.resize_llff_images import resize_llff
+
+TOOLS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "tools")
 
 
 # ------------------------------------------------------------- resize tool
@@ -104,3 +110,49 @@ def test_multihost_real_failure_with_coordinator_raises(dist_calls):
     dist_calls["raise"] = RuntimeError("connection refused")
     with pytest.raises(RuntimeError, match="connection refused"):
         init_multihost(coordinator="10.0.0.1:1234")
+
+
+def test_profile_summary_top_op_table(tmp_path):
+    """tools/profile_summary.py on a synthetic Chrome trace shaped like a
+    jax.profiler TPU capture: device lanes selected by process name, host
+    lanes excluded, ops ranked by total duration with correct pct/calls."""
+    events = [
+        # metadata: one TPU lane (pid 7), one host/python lane (pid 3)
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0 TensorCore"}},
+        {"ph": "M", "name": "process_name", "pid": 3,
+         "args": {"name": "python main thread"}},
+        # device ops: fusion.1 runs twice (300+200us), conv.2 once (400us)
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 0, "dur": 300.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 400, "dur": 200.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "conv.2", "ts": 700, "dur": 400.0},
+        # host event must NOT be counted
+        {"ph": "X", "pid": 3, "tid": 9, "name": "hostloop", "ts": 0, "dur": 9999.0},
+    ]
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    with gzip.open(run / "host.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "profile_summary.py"),
+         str(tmp_path), "--top", "5"],
+        capture_output=True, text=True, check=True,
+    )
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    header, rows = lines[0], lines[1:]
+    assert header["device_lanes"] == ["/device:TPU:0 TensorCore"]
+    assert header["device_total_ms"] == 0.9
+    assert [r["op"] for r in rows] == ["fusion.1", "conv.2"]
+    assert rows[0]["total_ms"] == 0.5 and rows[0]["calls"] == 2
+    assert rows[0]["pct"] == 55.6 and rows[1]["pct"] == 44.4
+    assert not any(r["op"] == "hostloop" for r in rows)
+
+
+def test_profile_summary_empty_dir(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "profile_summary.py"), str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 1
+    assert "error" in json.loads(out.stdout)
