@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Inference micro-benchmark: predict-once / render-many fps.
+
+The reference's signature demo is one image -> camera-path video
+(image_to_video.py:221-257, a per-frame python loop). Here the whole
+trajectory renders as one jitted on-device `lax.map`
+(mine_tpu/inference/video.py:render_many); this tool measures it so the
+README's fps claim is a captured artifact, not a self-report. Completion is
+forced by host-fetching a pixel — jax.block_until_ready returns early over
+this environment's tunneled TPU backend (bench.py has the history).
+
+  python tools/bench_infer.py                   # LLFF-recipe shape
+  python tools/bench_infer.py --h 768 --w 1024 --planes 128   # stretch
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--h", type=int, default=384)
+    ap.add_argument("--w", type=int, default=512)
+    ap.add_argument("--planes", type=int, default=32)
+    ap.add_argument("--poses", type=int, default=90,
+                    help="trajectory length (reference swing preset: 90)")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    # JAX_PLATFORMS=cpu must actually mean CPU even though the axon TPU
+    # plugin self-registers (and hangs at init on a dead tunnel)
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.config import Config
+    from mine_tpu.inference.video import fov_intrinsics, render_many
+    from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
+    cfg = Config().replace(**{
+        "data.img_h": args.h, "data.img_w": args.w,
+        "mpi.num_bins_coarse": args.planes,
+    })
+    rng = np.random.default_rng(0)
+    s, h, w = args.planes, args.h, args.w
+    # random MPI stands in for a network prediction: render cost does not
+    # depend on the values, only the shapes
+    mpi_rgb = jnp.asarray(rng.uniform(size=(1, s, h, w, 3)), jnp.float32)
+    mpi_sigma = jnp.asarray(rng.uniform(0.1, 2.0, size=(1, s, h, w, 1)), jnp.float32)
+    disparity = jnp.asarray(np.linspace(1.0, 0.001, s, dtype=np.float32))[None]
+    k = jnp.asarray(fov_intrinsics(h, w, 90.0))[None]
+    from mine_tpu.inference.trajectory import camera_trajectories
+
+    trajs, _fps = camera_trajectories(cfg.data.name)
+    base = trajs[-1][1]  # swing preset (llff: 90 poses)
+    idx = np.arange(args.poses) % base.shape[0]
+    poses = jnp.asarray(base[idx])
+
+    def run():
+        rgb, disp = render_many(cfg, mpi_rgb, mpi_sigma, disparity, k, poses)
+        return rgb
+
+    t0 = time.perf_counter()
+    out = run()
+    float(jnp.sum(out[0, 0, 0]))  # forcing fetch
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = run()
+    float(jnp.sum(out[0, 0, 0]))
+    dt = (time.perf_counter() - t0) / args.iters
+
+    fps = args.poses / dt
+    print(json.dumps({
+        "metric": "infer_render_many_fps",
+        "fps": round(fps, 1),
+        "ms_per_frame": round(dt / args.poses * 1e3, 2),
+        "poses": args.poses,
+        "h": h, "w": w, "planes": s,
+        "compile_s": round(compile_s, 1),
+        "backend": jax.default_backend(),
+        "device": jax.devices()[0].device_kind,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,9 @@
 #   2. bench_warp full-res   -> bench_warp_r04.json   (banded kernel at 1008x756)
 #   3. bench_warp bench shape-> bench_warp_384_r04.json (resident kernel, 384x512)
 #   4. bench.py width knob   -> bench_r04_width64.json (decoder widths padded to 64)
+#   5. bench_warp C=4        -> bench_warp_384c4_r04.json (post-refactor hot shape)
+#   6. bench_infer recipe    -> bench_infer_r04.json   (render-many fps, 384x512 S=32)
+#   7. bench_infer stretch   -> bench_infer_highres_r04.json (1024x768 S=128, banded)
 set -u
 cd /root/repo
 INTERVAL="${PROBE_INTERVAL:-300}"
@@ -74,11 +77,29 @@ while true; do
                 --n 64 --h 384 --w 512 --c 4 --mode resident --grad \
                 >bench_warp_384c4_r04.json 2>bench_warp_384c4_r04.err
             echo "$(date -u +%H:%M:%S) stage 5 rc=$?" >&2
+            alive || { sleep "$INTERVAL"; continue; }
+        fi
+        # predict-once/render-many fps: recipe shape, then the stretch MPI
+        if ! good bench_infer_r04.json '"fps"'; then
+            echo "$(date -u +%H:%M:%S) stage 6: bench_infer recipe shape" >&2
+            timeout 1800 python tools/bench_infer.py \
+                >bench_infer_r04.json 2>bench_infer_r04.err
+            echo "$(date -u +%H:%M:%S) stage 6 rc=$?" >&2
+            alive || { sleep "$INTERVAL"; continue; }
+        fi
+        if ! good bench_infer_highres_r04.json '"fps"'; then
+            echo "$(date -u +%H:%M:%S) stage 7: bench_infer stretch shape" >&2
+            timeout 1800 python tools/bench_infer.py \
+                --h 768 --w 1024 --planes 128 --poses 30 \
+                >bench_infer_highres_r04.json 2>bench_infer_highres_r04.err
+            echo "$(date -u +%H:%M:%S) stage 7 rc=$?" >&2
         fi
         if good bench_r04_tpu.json '"value"' \
             && good bench_warp_r04.json '"warp_grad_banded"' \
             && good bench_warp_384_r04.json '"warp_fwd_xla"' \
             && good bench_warp_384c4_r04.json '"warp_grad_resident"' \
+            && good bench_infer_r04.json '"fps"' \
+            && good bench_infer_highres_r04.json '"fps"' \
             && good bench_r04_width64.json '"value"'; then
             echo "$(date -u +%H:%M:%S) all stages complete" >&2
             exit 0
