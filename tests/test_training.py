@@ -304,3 +304,25 @@ def test_eval_step_runs_and_matches_keys():
     assert np.isfinite(float(loss_dict["loss"]))
     assert viz["tgt_imgs_syn"].shape == (1, cfg.data.img_h, cfg.data.img_w, 3)
     assert viz["src_disparity_syn"].shape == (1, cfg.data.img_h, cfg.data.img_w, 1)
+
+
+def test_highres_recipe_constructs_abstractly():
+    """The shipped 1024x768 S=128 stretch recipe must stay constructible:
+    abstract init of the FULL model at recipe shapes (no FLOPs) catches any
+    future shape/constraint regression (e.g. the decoder's 128-multiple
+    extension) without paying a real compile."""
+    from conftest import load_shipped_config
+
+    cfg = load_shipped_config("default", "llff_highres")
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=10)
+    shapes = jax.eval_shape(
+        lambda: init_state(cfg, model, tx, jax.random.PRNGKey(0),
+                           load_pretrained=False)
+    )
+    n_params = sum(
+        int(np.prod(l.shape)) if l.shape else 1
+        for l in jax.tree_util.tree_leaves(shapes.params)
+    )
+    # ResNet-50 encoder + decoder: ~36M (exact value may drift with heads)
+    assert 30_000_000 < n_params < 45_000_000
