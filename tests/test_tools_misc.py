@@ -156,3 +156,82 @@ def test_profile_summary_empty_dir(tmp_path):
     )
     assert out.returncode == 1
     assert "error" in json.loads(out.stdout)
+
+
+# ------------------------------------------------- platform forcing guard
+
+
+class TestHonorJaxPlatforms:
+    """mine_tpu/utils/platform.py — each branch needs a FRESH process (the
+    forcing is a no-op once a JAX backend initializes), so these drive
+    `python -c` subprocesses."""
+
+    def _run(self, code, **env):
+        full_env = {k: v for k, v in os.environ.items()
+                    if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+        full_env.update(env)
+        # timeout: a regression that touches the accelerator tunnel HANGS
+        # (the guarded-against failure) — it must fail red, not deadlock CI
+        return subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env=full_env, cwd=os.path.join(os.path.dirname(__file__), ".."),
+            timeout=120,
+        )
+
+    def test_cpu_request_is_honored(self):
+        out = self._run(
+            "from mine_tpu.utils.platform import honor_jax_platforms\n"
+            "honor_jax_platforms()\n"
+            "import jax\n"
+            "print(jax.default_backend(), jax.device_count())",
+            JAX_PLATFORMS="cpu",
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        assert out.stdout.split() == ["cpu", "1"]
+
+    def test_preset_device_count_preserved(self):
+        out = self._run(
+            "from mine_tpu.utils.platform import honor_jax_platforms\n"
+            "honor_jax_platforms()\n"
+            "import jax\n"
+            "print(jax.default_backend(), jax.device_count())",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=3",
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        assert out.stdout.split() == ["cpu", "3"]
+
+    def test_no_op_without_cpu_request(self):
+        # without JAX_PLATFORMS=cpu the guard must return untouched: no env
+        # mutation, no platform forcing. (It cannot be probed via
+        # sys.modules — this image preloads jax at interpreter startup —
+        # and probing the default backend would touch the possibly-hung
+        # accelerator tunnel, the exact thing the guard avoids.)
+        out = self._run(
+            "import os\n"
+            "from mine_tpu.utils.platform import honor_jax_platforms\n"
+            "honor_jax_platforms()\n"
+            "print(repr(os.environ.get('JAX_PLATFORMS')),\n"
+            "      repr(os.environ.get('XLA_FLAGS')))",
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        assert out.stdout.split() == ["None", "None"]
+
+    def test_late_forcing_raises(self):
+        # commit a 1-device CPU backend, then try to re-force to 4: the
+        # flags are consumed at init, so the guard must raise rather than
+        # let a wrong-size mesh fail later. (Touching the backend WITHOUT
+        # forcing first would hang on a dead axon tunnel — the exact
+        # failure the guard exists to prevent, and not something a test
+        # should depend on.)
+        out = self._run(
+            "from mine_tpu.utils.platform import force_cpu_devices\n"
+            "force_cpu_devices(1)\n"
+            "try:\n"
+            "    force_cpu_devices(4)\n"
+            "except RuntimeError as e:\n"
+            "    print('RAISED', 'fresh process' in str(e))\n",
+            JAX_PLATFORMS="cpu",
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        assert out.stdout.split() == ["RAISED", "True"]
