@@ -46,14 +46,14 @@ CROP = 16  # interior crop: border band is clamp-padding, not scene content
 
 
 def build_cfg(height: int, width: int, batch: int, num_planes: int,
-              disparity_end: float = 0.2):
+              disparity_end: float = 0.2, num_layers: int = 18):
     from mine_tpu.config import Config
 
     return Config().replace(**{
         "data.name": "synthetic",
         "data.img_h": height, "data.img_w": width,
         "data.per_gpu_batch_size": batch,
-        "model.num_layers": 18,
+        "model.num_layers": num_layers,
         "model.dtype": "float32",  # CPU path; bf16 is a TPU-bench concern
         "mpi.num_bins_coarse": num_planes,
         # bracket the scene's depth range (near 1.0, far 4.0) instead of the
@@ -136,9 +136,32 @@ def main() -> None:
     ap.add_argument("--eval-phases", type=int, default=1, choices=(1, 2, 3),
                     help="held-out scenes to average the eval over "
                          "(single-scene eval carries ~±1.5 dB noise)")
+    ap.add_argument("--layers", type=int, default=18,
+                    help="ResNet encoder depth (18/34/50/101/152)")
+    ap.add_argument("--save-final", default="",
+                    help="if set, serialize final {params, batch_stats} to "
+                         "this path (flax msgpack) so post-run analysis — "
+                         "e.g. the does-the-trained-net-inpaint-past-the-"
+                         "src-copy-oracle check — can load the model "
+                         "without retraining")
     args = ap.parse_args()
     if args.steps < 1:
         ap.error("--steps must be >= 1")
+    if args.save_final:
+        # fail on an unwritable path NOW, not after hours of training —
+        # without creating the file: a crash mid-run must leave a clear
+        # missing-file signal, not a zero-byte artifact
+        save_dir = os.path.dirname(os.path.abspath(args.save_final))
+        try:
+            os.makedirs(save_dir, exist_ok=True)
+            # actually create+delete a probe file: os.access() is vacuously
+            # true for root, which is how this environment runs
+            import tempfile
+
+            with tempfile.TemporaryFile(dir=save_dir):
+                pass
+        except OSError as e:
+            ap.error(f"--save-final directory not writable: {save_dir} ({e})")
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # the full forcing recipe (env flags + in-process jax.config update +
@@ -163,7 +186,7 @@ def main() -> None:
     )
 
     cfg = build_cfg(args.height, args.width, args.batch, args.planes,
-                    disparity_end=args.disparity_end)
+                    disparity_end=args.disparity_end, num_layers=args.layers)
     model = build_model(cfg)
     tx = make_optimizer(cfg, steps_per_epoch=args.steps)
     state = init_state(cfg, model, tx, jax.random.PRNGKey(cfg.training.seed))
@@ -198,6 +221,16 @@ def main() -> None:
             curve.write(json.dumps(row) + "\n")
             curve.flush()
             print(json.dumps(row), file=sys.stderr, flush=True)
+
+    if args.save_final:
+        from flax import serialization
+
+        # tmp + rename: the path only ever holds a complete serialization
+        tmp_path = args.save_final + ".tmp"
+        with open(tmp_path, "wb") as f:
+            f.write(serialization.to_bytes(
+                {"params": state.params, "batch_stats": state.batch_stats}))
+        os.replace(tmp_path, args.save_final)
 
     final = {
         "metric": "synthetic_novel_pose_psnr_after_training",
