@@ -266,8 +266,9 @@ def _run() -> None:
         "note": (
             "vs_baseline awaits a reference denominator on comparable "
             "hardware (the reference repo publishes no throughput, SURVEY.md "
-            "§6; the only measured head-to-head is same-host CPU: ours 1.44x "
-            "the reference torch step, BASELINE.md r4); mfu = XLA "
+            "§6; the only measured head-to-head is same-host idle CPU: ours "
+            "0.92x the reference torch step — rough parity; an earlier 1.44x "
+            "was retracted as background-load skew, BASELINE.md r4); mfu = XLA "
             "cost-analysis FLOPs / published chip peak; B=2 is the reference "
             "recipe's per-GPU batch (params_llff.yaml), not a TPU constraint "
             "— see the b8 fields for the hardware-friendly point"

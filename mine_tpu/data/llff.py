@@ -68,10 +68,43 @@ def load_scene(
     max_images: int | None = None,
     min_points: int = 1,
 ) -> list[PosedImage]:
-    """Load every posed image of one COLMAP scene (nerf_dataset.py:61-98)."""
+    """Load every posed image of one COLMAP scene (nerf_dataset.py:61-98).
+
+    Robustness deviations from the reference (all fail-loud or accounted,
+    VERDICT r4 #6 — real COLMAP output is messier than fixtures):
+      * SIMPLE_RADIAL distortion is read and IGNORED exactly like the
+        reference (nerf_dataset.py:154-163 uses params[0:3] only), but a
+        non-trivial coefficient warns instead of silently mis-projecting.
+      * points landing behind (or on) an image's camera plane are dropped
+        from that image's track — a negative/zero depth would flow into
+        1/z disparity supervision and NaN the loss.
+      * a track referencing a 3D point id missing from points3D fails with
+        the offending image, not a bare KeyError.
+    """
     cameras, images, points3d = colmap.read_model(os.path.join(scene_dir, "sparse/0"))
     assert len(cameras) == 1, f"{scene_dir}: expected a single shared camera"
     cam = next(iter(cameras.values()))
+    # K below is built from params[0:3] as (f, cx, cy) — the SIMPLE_* layout.
+    # Other COLMAP models (PINHOLE: fx,fy,cx,cy; RADIAL/OPENCV: more) would
+    # be silently MISREAD under that indexing, so reject them loudly rather
+    # than warn (the reference hard-assumes SIMPLE_RADIAL and would misread
+    # them the same way, nerf_dataset.py:154-163).
+    if cam.model not in ("SIMPLE_PINHOLE", "SIMPLE_RADIAL"):
+        raise ValueError(
+            f"{scene_dir}: camera model {cam.model} has a parameter layout "
+            "this loader (and the reference) cannot read; re-run COLMAP "
+            "with a SIMPLE_* camera model, or extend load_scene"
+        )
+    if len(cam.params) > 3 and np.any(np.abs(cam.params[3:]) > 1e-8):
+        import warnings
+
+        warnings.warn(
+            f"{scene_dir}: camera model {cam.model} has non-trivial "
+            f"distortion params {cam.params[3:].tolist()} which are IGNORED "
+            "(reference parity, nerf_dataset.py:154-163); undistort images "
+            "first (colmap image_undistorter) for geometric accuracy",
+            stacklevel=2,
+        )
 
     out: list[PosedImage] = []
     for img_id in sorted(images):
@@ -107,13 +140,23 @@ def load_scene(
         g[:3, 3] = t
 
         tracked = meta.point3d_ids >= 0
-        world = np.stack(
-            [points3d[pid].xyz for pid in meta.point3d_ids[tracked]]
-        ) if tracked.any() else np.zeros((0, 3))
+        try:
+            world = np.stack(
+                [points3d[pid].xyz for pid in meta.point3d_ids[tracked]]
+            ) if tracked.any() else np.zeros((0, 3))
+        except KeyError as e:
+            raise ValueError(
+                f"{path}: track references 3D point id {e.args[0]} absent "
+                "from points3D — corrupt/truncated COLMAP model"
+            ) from None
         pts_cam = (world @ r.T + t).astype(np.float32)  # (N, 3)
+        n_tracked = len(pts_cam)
+        pts_cam = pts_cam[pts_cam[:, 2] > 1e-6]  # behind-camera culling
         if len(pts_cam) < min_points:
             raise ValueError(
-                f"{path}: {len(pts_cam)} tracked points < required {min_points}"
+                f"{path}: {len(pts_cam)} usable points < required "
+                f"{min_points} ({n_tracked} tracked, "
+                f"{n_tracked - len(pts_cam)} culled for non-positive depth)"
             )
         out.append(PosedImage(os.path.basename(scene_dir), arr, k, g, pts_cam))
     return out
@@ -200,6 +243,15 @@ class LLFFDataset:
         # train.py:110); __len__ must agree with what epoch() yields
         return len(self.images) // n_src
 
+    @property
+    def num_eval_examples(self) -> int:
+        """Genuine (weight-1) examples one val epoch yields: every image
+        serves as source exactly once, num_tgt_views pairs each. The eval
+        loop audits its metered count against this (training/loop.py
+        run_evaluation) so a wrap-pad miscount can't silently skew the one
+        number users compare."""
+        return len(self.images) * self.num_tgt_views
+
     def _examples(self, src_idx: int, rng: np.random.Generator) -> list[dict[str, np.ndarray]]:
         """num_tgt_views (src, tgt) pairs for one source view."""
         src = self.images[src_idx]
@@ -239,18 +291,25 @@ class LLFFDataset:
         n_src = self.global_batch // self.num_tgt_views
         for start in range(0, len(self) * n_src, n_src):
             idxs = order[start : start + n_src]
-            if len(idxs) < n_src:
+            n_genuine = len(idxs)
+            if n_genuine < n_src:
                 if not self.is_val:  # drop_last, like the reference's train
                     break            # DataLoader (train.py:110, drop_last=True)
                 # Val: wrap-pad the tail from the start of the order so every
                 # image is evaluated under one static batch shape (XLA: no
                 # ragged batches; a short batch would force a recompile and
-                # break even sharding across the data mesh axis). The few
-                # wrapped examples are re-evaluated — a slight over-weighting
-                # in the epoch average, vs the reference's skipping them
-                # entirely before round 4.
+                # break even sharding across the data mesh axis). Padded
+                # slots carry eval_weight 0.0 below, so the epoch average
+                # counts every genuine example exactly once — parity with
+                # the reference's full-set mean over its ragged final batch
+                # (synthesis_task.py:506-515, update(..., n=B)).
                 idxs = np.concatenate([idxs, np.resize(order, n_src - len(idxs))])
             examples = [e for i in idxs for e in self._examples(int(i), rng)]
-            yield {
+            batch = {
                 k: np.stack([e[k] for e in examples]) for k in examples[0]
             }
+            if self.is_val:
+                # per-example validity: num_tgt_views examples per source
+                src_w = (np.arange(len(idxs)) < n_genuine).astype(np.float32)
+                batch["eval_weight"] = np.repeat(src_w, self.num_tgt_views)
+            yield batch

@@ -85,8 +85,11 @@ def _vgg_taps(params: dict, x: Array) -> list[Array]:
     return taps
 
 
-def lpips(params: dict, img1: Array, img2: Array) -> Array:
-    """Mean LPIPS distance between (B, H, W, 3) image batches.
+def lpips(
+    params: dict, img1: Array, img2: Array, size_average: bool = True
+) -> Array:
+    """Mean (or per-image (B,), when not size_average) LPIPS distance
+    between (B, H, W, 3) image batches.
 
     Like the reference call site, images are passed through unchanged (the
     reference feeds [0,1] images to an LPIPS configured for [-1,1] — a quirk
@@ -104,4 +107,4 @@ def lpips(params: dict, img1: Array, img2: Array) -> Array:
         # lin layer: non-negative per-channel weights, 1x1 conv to 1 channel
         weighted = jnp.sum(diff * lin_w, axis=-1)  # (B, H, W)
         total = total + jnp.mean(weighted, axis=(1, 2))
-    return jnp.mean(total)
+    return jnp.mean(total) if size_average else total

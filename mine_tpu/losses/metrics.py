@@ -10,11 +10,13 @@ import jax.numpy as jnp
 from jax import Array
 
 
-def psnr(img1: Array, img2: Array) -> Array:
-    """Mean PSNR over a batch of (B, H, W, C) images in [0, 1]
-    (layers.py:48-51)."""
+def psnr(img1: Array, img2: Array, size_average: bool = True) -> Array:
+    """Mean (or per-image (B,), when not size_average) PSNR over a batch of
+    (B, H, W, C) images in [0, 1] (layers.py:48-51 — the reference averages
+    per-image PSNRs, not PSNR of the pooled MSE)."""
     mse = jnp.mean((img1 - img2) ** 2, axis=(1, 2, 3))
-    return jnp.mean(20.0 * jnp.log10(1.0 / jnp.sqrt(mse)))
+    per_image = 20.0 * jnp.log10(1.0 / jnp.sqrt(mse))
+    return jnp.mean(per_image) if size_average else per_image
 
 
 def compute_scale_factor(disparity_syn_pt3d: Array, pt3d_disp: Array) -> Array:
@@ -28,15 +30,19 @@ def compute_scale_factor(disparity_syn_pt3d: Array, pt3d_disp: Array) -> Array:
 
 
 def log_disparity_loss(
-    disparity_syn_pt3d: Array, pt3d_disp: Array, scale_factor: Array
+    disparity_syn_pt3d: Array, pt3d_disp: Array, scale_factor: Array,
+    size_average: bool = True,
 ) -> Array:
     """L1 in log space between scale-calibrated synthesized disparity and
     sparse-point disparity (synthesis_task.py:325-339).
 
     disparity_syn_pt3d / pt3d_disp: (B, N, 1) or (B, N); scale_factor: (B,).
+    Scalar, or per-image (B,) when not size_average (uniform N makes the
+    decomposition exact).
     """
     b = disparity_syn_pt3d.shape[0]
     syn = disparity_syn_pt3d.reshape(b, -1)
     gt = pt3d_disp.reshape(b, -1)
     scaled = syn / scale_factor[:, None]
-    return jnp.mean(jnp.abs(jnp.log(scaled) - jnp.log(gt)))
+    per_image = jnp.mean(jnp.abs(jnp.log(scaled) - jnp.log(gt)), axis=1)
+    return jnp.mean(per_image) if size_average else per_image

@@ -59,7 +59,8 @@ def _instance_norm(x: Array, eps: float = 1.0e-5) -> Array:
 
 
 def edge_aware_loss(
-    img: Array, disp: Array, gmin: float, grad_ratio: float = 0.1
+    img: Array, disp: Array, gmin: float, grad_ratio: float = 0.1,
+    size_average: bool = True,
 ) -> Array:
     """Hinged, edge-masked smoothness (layers.py:54-80).
 
@@ -67,6 +68,10 @@ def edge_aware_loss(
     Image-gradient magnitudes (summed over channels, normalized by the per-
     image max * grad_ratio, clipped at 1) gate an instance-normalized
     disparity-gradient hinge at gmin.
+
+    Returns a scalar (size_average) or per-image (B,) means — the pixel
+    count is uniform across the batch, so the scalar equals the mean of the
+    per-image values (the decomposition the masked val eval relies on).
     """
     gx, gy = spatial_gradient(img, normalized=True)
     grad_img_x = jnp.sum(jnp.abs(gx), axis=-1, keepdims=True)  # (B, H, W, 1)
@@ -82,13 +87,19 @@ def edge_aware_loss(
 
     loss_x = jnp.maximum(grad_disp_x, 0.0) * (1.0 - edge_mask_x)
     loss_y = jnp.maximum(grad_disp_y, 0.0) * (1.0 - edge_mask_y)
-    return jnp.mean(loss_x + loss_y)
+    if size_average:
+        return jnp.mean(loss_x + loss_y)
+    return jnp.mean(loss_x + loss_y, axis=(1, 2, 3))
 
 
-def edge_aware_loss_v2(img: Array, disp: Array) -> Array:
+def edge_aware_loss_v2(
+    img: Array, disp: Array, size_average: bool = True
+) -> Array:
     """monodepth2-style mean-normalized smoothness (layers.py:83-99).
 
-    img: (B, H, W, 3); disp: (B, H, W, 1).
+    img: (B, H, W, 3); disp: (B, H, W, 1). Scalar, or per-image (B,) when
+    not size_average (see edge_aware_loss on why the decomposition is
+    exact).
     """
     mean_disp = jnp.mean(disp, axis=(1, 2), keepdims=True)
     disp = disp / (mean_disp + 1.0e-7)
@@ -103,6 +114,7 @@ def edge_aware_loss_v2(img: Array, disp: Array) -> Array:
         jnp.abs(img[:, :-1] - img[:, 1:]), axis=-1, keepdims=True
     )
 
-    return jnp.mean(grad_disp_x * jnp.exp(-grad_img_x)) + jnp.mean(
-        grad_disp_y * jnp.exp(-grad_img_y)
+    axes = (1, 2, 3) if not size_average else None
+    return jnp.mean(grad_disp_x * jnp.exp(-grad_img_x), axis=axes) + jnp.mean(
+        grad_disp_y * jnp.exp(-grad_img_y), axis=axes
     )

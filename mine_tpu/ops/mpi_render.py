@@ -145,6 +145,12 @@ def weighted_sum_src(
     constant plane depth (no xyz tensor).
 
     rgb: (B, S, H, W, 3); mpi_disparity: (B, S); weights: (B, S, H, W, 1).
+
+    Assumes NORMALIZED intrinsics — K^-1's third row [0, 0, 1] — so that
+    per-plane camera z equals the plane depth 1/disparity; the generic
+    weighted_sum_mpi takes z from an explicit xyz tensor and carries no such
+    assumption. Every shipped config satisfies it (scale_intrinsics keeps
+    K[2,2] = 1); a non-standard K would silently skew depth outputs here.
     """
     z = (1.0 / mpi_disparity)[:, :, None, None, None]  # (B, S, 1, 1, 1)
     weights_sum = jnp.sum(weights, axis=1)
@@ -169,6 +175,10 @@ def render_src(
     rgb: (B, S, H, W, 3); sigma: (B, S, H, W, 1); mpi_disparity: (B, S);
     k_inv: (B, 3, 3). Returns (imgs_syn, depth_syn, blend_weights, weights)
     exactly like `render`.
+
+    Assumes normalized intrinsics (K[2,2] = 1): the factored distances and
+    the per-plane z both use depth = 1/disparity as the camera-frame z —
+    see weighted_sum_src.
     """
     h, w = rgb.shape[2], rgb.shape[3]
     if use_alpha:

@@ -176,6 +176,9 @@ def sharded_render_src(
     distance at the chunk boundary needs only the NEXT device's first plane
     DEPTH — a (B,) halo instead of the (B, H, W, 3) xyz halo the generic
     sharded path ships.
+
+    Like the dense ops.render_src, assumes normalized intrinsics
+    (K[2,2] = 1) so per-plane camera z == 1/disparity.
     """
     if use_alpha:
         imgs_syn, weights = sharded_alpha_composition(sigma, rgb, axis_name)
@@ -221,7 +224,8 @@ def sharded_weighted_sum_src(
     is_bg_depth_inf: bool = False,
 ) -> tuple[Array, Array]:
     """Plane-sharded weighted_sum_src: per-plane z is the constant local
-    plane depth (unsharded twin: ops.weighted_sum_src)."""
+    plane depth (unsharded twin: ops.weighted_sum_src — including its
+    normalized-intrinsics assumption, K[2,2] = 1)."""
     z = (1.0 / mpi_disparity)[:, :, None, None, None]
     weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
     rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)

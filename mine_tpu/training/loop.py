@@ -282,10 +282,23 @@ def run_evaluation(
     meters = {k: AverageMeter(k) for k in LOSS_KEYS}
     key = jax.random.PRNGKey(cfg.training.seed + 17)
     viz = None
+    n_examples = 0
     for i, batch in enumerate(staged_batches(mesh, cfg.data.num_workers, val_ds.epoch(0))):
         loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
+        # metric values are weighted means over GENUINE examples only
+        # (wrap-padded slots carry eval_weight 0, training/step.py
+        # make_eval_step); weighting the meter by the genuine count matches
+        # the reference's update(..., n=B) over its ragged final batch
+        n_batch = int(round(float(loss_dict["eval_examples"])))
+        n_examples += n_batch
         for k in LOSS_KEYS:
-            meters[k].update(float(loss_dict[k]))
+            meters[k].update(float(loss_dict[k]), n=n_batch)
+    expected = getattr(val_ds, "num_eval_examples", None)
+    if expected is not None and n_examples != expected:
+        raise RuntimeError(
+            f"eval example count mismatch: metered {n_examples}, dataset "
+            f"holds {expected} — the wrap-pad mask is miscounting"
+        )
     result = {k: m.avg for k, m in meters.items()}
     logger.info(
         "eval @ %d: " + " ".join(f"{k}=%.4f" for k in ("loss", "loss_rgb_tgt", "psnr_tgt", "lpips_tgt")),

@@ -236,8 +236,17 @@ def loss_fcn_per_scale(
     is_val: bool,
     lpips_params: dict | None,
     compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    per_example: bool = False,
 ) -> tuple[dict[str, Array], dict[str, Array], Array]:
     """One scale of the supervision graph (synthesis_task.py:234-390).
+
+    With `per_example`, every loss_dict entry is (B,) per-example means
+    instead of batch-mean scalars (bit-identical train path stays on the
+    scalar branch). The decomposition is exact for every term — uniform
+    pixel/point counts, and psnr/ssim/lpips are per-image by construction —
+    which is what lets the val wrap-pad be masked without bias: the eval
+    step weights these vectors by batch["eval_weight"] so duplicated pad
+    slots contribute zero (VERDICT r4 #5).
 
     All S-axis reductions go through `compositor` — the plane-sharded twin
     makes this same graph run on S_local plane chunks with psum composites
@@ -286,11 +295,14 @@ def loss_fcn_per_scale(
         )
         if scale_factor is None:
             scale_factor = compute_scale_factor(src_pt_disp_syn, src_pt_disp)
-        loss_disp_src = log_disparity_loss(src_pt_disp_syn, src_pt_disp, scale_factor)
+        loss_disp_src = log_disparity_loss(
+            src_pt_disp_syn, src_pt_disp, scale_factor,
+            size_average=not per_example,
+        )
     else:
         if scale_factor is None:
             scale_factor = jnp.ones((b,), jnp.float32)
-        loss_disp_src = jnp.zeros(())
+        loss_disp_src = jnp.zeros((b,) if per_example else ())
 
     render_results = render_novel_view(
         cfg, mpi_rgb, mpi_sigma, disparity,
@@ -306,42 +318,54 @@ def loss_fcn_per_scale(
         tgt_pt_disp_syn = ops.gather_pixel_by_pxpy(
             tgt_disparity_syn, _project_points(k_tgt, batch["pt3d_tgt"])
         )
-        loss_disp_tgt = log_disparity_loss(tgt_pt_disp_syn, tgt_pt_disp, scale_factor)
+        loss_disp_tgt = log_disparity_loss(
+            tgt_pt_disp_syn, tgt_pt_disp, scale_factor,
+            size_average=not per_example,
+        )
     else:
-        loss_disp_tgt = jnp.zeros(())
+        loss_disp_tgt = jnp.zeros((b,) if per_example else ())
 
+    sa = not per_example  # size_average for every decomposable metric
     # target-frame supervised terms (:341-356)
     valid_mask = (tgt_mask >= cfg.mpi.valid_mask_threshold).astype(jnp.float32)
-    loss_rgb_tgt = jnp.mean(jnp.abs(tgt_syn - tgt_img) * valid_mask)
-    loss_ssim_tgt = 1.0 - ssim(tgt_syn, tgt_img)
+    rgb_err_tgt = jnp.abs(tgt_syn - tgt_img) * valid_mask
+    loss_rgb_tgt = jnp.mean(rgb_err_tgt) if sa else jnp.mean(
+        rgb_err_tgt, axis=(1, 2, 3)
+    )
+    loss_ssim_tgt = 1.0 - ssim(tgt_syn, tgt_img, size_average=sa)
     loss_smooth_tgt = cfg.loss.smoothness_lambda_v1 * edge_aware_loss(
         tgt_img, tgt_disparity_syn,
         gmin=cfg.loss.smoothness_gmin, grad_ratio=cfg.loss.smoothness_grad_ratio,
+        size_average=sa,
     )
     loss_smooth_tgt_v2 = cfg.loss.smoothness_lambda_v2 * edge_aware_loss_v2(
-        tgt_img, tgt_disparity_syn
+        tgt_img, tgt_disparity_syn, size_average=sa
     )
     loss_smooth_src_v2 = cfg.loss.smoothness_lambda_v2 * edge_aware_loss_v2(
-        src_img, src_disparity_syn
+        src_img, src_disparity_syn, size_average=sa
     )
 
     # logged-only src terms, grad-blocked (reference torch.no_grad :312-323)
     src_syn_ng = lax.stop_gradient(src_syn)
     src_disp_ng = lax.stop_gradient(src_disparity_syn)
-    loss_rgb_src = jnp.mean(jnp.abs(src_syn_ng - src_img))
-    loss_ssim_src = 1.0 - ssim(src_syn_ng, src_img)
+    rgb_err_src = jnp.abs(src_syn_ng - src_img)
+    loss_rgb_src = jnp.mean(rgb_err_src) if sa else jnp.mean(
+        rgb_err_src, axis=(1, 2, 3)
+    )
+    loss_ssim_src = 1.0 - ssim(src_syn_ng, src_img, size_average=sa)
     loss_smooth_src = edge_aware_loss(
         src_img, src_disp_ng,
         gmin=cfg.loss.smoothness_gmin, grad_ratio=cfg.loss.smoothness_grad_ratio,
+        size_average=sa,
     )
 
     # eval-only metrics (:357-363)
     tgt_syn_ng = lax.stop_gradient(tgt_syn)
-    psnr_tgt = psnr(tgt_syn_ng, tgt_img)
+    psnr_tgt = psnr(tgt_syn_ng, tgt_img, size_average=sa)
     if is_val and scale == 0 and lpips_params is not None:
-        lpips_tgt = lpips_fn(lpips_params, tgt_syn_ng, tgt_img)
+        lpips_tgt = lpips_fn(lpips_params, tgt_syn_ng, tgt_img, size_average=sa)
     else:
-        lpips_tgt = jnp.zeros(())
+        lpips_tgt = jnp.zeros((b,) if per_example else ())
 
     loss = (
         loss_disp_tgt + loss_disp_src
@@ -387,11 +411,14 @@ def loss_fcn(
     train: bool = True,
     plane_axis: str | None = None,
     compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    per_example: bool = False,
 ) -> tuple[Array, dict[str, Array], dict[str, Array], Any]:
     """Forward + all 4 scale losses + multi-scale aggregation
     (synthesis_task.py:392-418).
 
     Returns (total_loss, loss_dict, visualization_dict, new_batch_stats).
+    With `per_example` (eval only), loss_dict entries — including the
+    aggregated "loss" — are (B,) vectors; see loss_fcn_per_scale.
     """
     key_disp, key_fine, key_dropout = jax.random.split(key, 3)
     if plane_axis is not None:
@@ -417,6 +444,7 @@ def loss_fcn(
         ld, vz, scale_factor = loss_fcn_per_scale(
             cfg, scale, batch, mpis[scale], disparity, scale_factor,
             is_val=is_val, lpips_params=lpips_params, compositor=compositor,
+            per_example=per_example,
         )
         loss_dicts.append(ld)
         viz_dicts.append(vz)
@@ -513,13 +541,30 @@ def make_eval_step(
     def eval_step(state: TrainState, batch: dict[str, Array], key: Array):
         if axis_name is not None:
             key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        batch = dict(batch)
+        # per-example validity: 0.0 on wrap-padded val slots (data/llff.py
+        # epoch), absent for datasets that never pad
+        weight = batch.pop("eval_weight", None)
         _total, loss_dict, viz, _ = loss_fcn(
             cfg, model, state.params, state.batch_stats, batch, key,
             is_val=True, lpips_params=lpips_params, train=False,
             plane_axis=plane_axis, compositor=compositor,
+            per_example=True,
         )
+        if weight is None:
+            weight = jnp.ones_like(loss_dict["psnr_tgt"])
+        # exact weighted mean under data sharding: psum numerator and
+        # denominator separately (a pmean of per-shard weighted means would
+        # over-weight shards whose pad slots landed elsewhere)
+        num = jax.tree.map(lambda v: jnp.sum(v * weight), loss_dict)
+        den = jnp.sum(weight)
         if axis_name is not None:
-            loss_dict = lax.pmean(loss_dict, axis_name)
+            num = lax.psum(num, axis_name)
+            den = lax.psum(den, axis_name)
+        loss_dict = jax.tree.map(lambda n: n / jnp.maximum(den, 1.0), num)
+        # genuine-example count for this batch: the meter weight (reference
+        # updates with n=B, synthesis_task.py:535) and the epoch-count audit
+        loss_dict["eval_examples"] = den
         return loss_dict, viz
 
     return eval_step

@@ -155,6 +155,190 @@ def test_llff_val_covers_every_image(tmp_path):
     assert len(tr) == 2 and len(list(tr.epoch(0))) == 2
 
 
+def test_llff_val_eval_weight_masks_wrap_pad(tmp_path):
+    """Val batches carry per-example eval_weight: 1.0 genuine, 0.0 on
+    wrap-padded tail slots; weights over the epoch sum to exactly
+    num_eval_examples (every image once per target view), which the eval
+    loop audits (training/loop.py run_evaluation). Train batches carry no
+    eval_weight at all."""
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=5)
+    scene = os.path.join(tmp_path, "scene_a")
+    os.rename(os.path.join(scene, "images"), os.path.join(scene, "images_val"))
+    ds = LLFFDataset(_llff_cfg(str(tmp_path)), "val", global_batch=2)
+    batches = list(ds.epoch(0))
+    assert all("eval_weight" in b for b in batches)
+    weights = np.concatenate([b["eval_weight"] for b in batches])
+    assert weights.shape == (6,)  # 3 static batches of 2
+    assert ds.num_eval_examples == 5
+    assert weights.sum() == 5.0
+    # the pad slot is the LAST slot of the final batch, and it duplicates a
+    # genuine source image from the start of the epoch order
+    assert list(batches[-1]["eval_weight"]) == [1.0, 0.0]
+    srcs = np.concatenate([b["src_img"] for b in batches])
+    genuine = {srcs[i].tobytes() for i in range(len(srcs)) if weights[i] == 1.0}
+    assert len(genuine) == 5
+    assert srcs[weights == 0.0][0].tobytes() in genuine
+
+    os.rename(os.path.join(scene, "images_val"), os.path.join(scene, "images"))
+    tr = LLFFDataset(_llff_cfg(str(tmp_path)), "train", global_batch=2)
+    assert all("eval_weight" not in b for b in tr.epoch(0))
+
+
+def _scene_model(scene_dir):
+    from mine_tpu.data import colmap
+
+    sparse = os.path.join(scene_dir, "sparse/0")
+    return colmap.read_model(sparse), sparse
+
+
+def test_llff_distortion_params_warn_but_load(tmp_path):
+    """SIMPLE_RADIAL with a non-trivial radial coefficient: the reference
+    silently ignores params[3] (nerf_dataset.py:154-163); we keep the
+    projection parity but warn loudly so real COLMAP output isn't
+    mis-trusted (VERDICT r4 #6)."""
+    from mine_tpu.data import colmap
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=4)
+    (cameras, images, pts), sparse = _scene_model(os.path.join(tmp_path, "scene_a"))
+    cam = cameras[1]
+    cameras[1] = colmap.Camera(
+        cam.id, cam.model, cam.width, cam.height,
+        np.concatenate([cam.params[:3], [0.12]]),
+    )
+    colmap.write_cameras_binary(cameras, os.path.join(sparse, "cameras.bin"))
+    with pytest.warns(UserWarning, match="distortion.*IGNORED"):
+        ds = LLFFDataset(_llff_cfg(str(tmp_path)), "train", global_batch=2)
+    assert len(ds.images) == 4  # still loads, geometry unchanged
+
+
+def test_llff_behind_camera_points_culled(tmp_path):
+    """A 3D point behind the cameras must not reach 1/z disparity
+    supervision (NaN); it is culled per-image, and the failure message
+    accounts for the culling when too few points remain."""
+    from mine_tpu.data import colmap
+    from mine_tpu.data.llff import load_scene
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=3)
+    scene_dir = os.path.join(tmp_path, "scene_a")
+    (cameras, images, pts), sparse = _scene_model(scene_dir)
+    bad_id = max(pts) + 1
+    pts[bad_id] = colmap.Point3D(
+        bad_id, np.array([0.0, 0.0, -2.0]), np.array([0, 255, 0], np.uint8), 0.5
+    )
+    for iid, m in list(images.items()):
+        images[iid] = colmap.ImageMeta(
+            m.id, m.qvec, m.tvec, m.camera_id, m.name,
+            np.concatenate([m.xys, [[1.0, 1.0]]]),
+            np.concatenate([m.point3d_ids, [bad_id]]),
+        )
+    colmap.write_points3d_binary(pts, os.path.join(sparse, "points3D.bin"))
+    colmap.write_images_binary(images, os.path.join(sparse, "images.bin"))
+
+    n_world = len(pts)
+    loaded = load_scene(scene_dir, "images", (64, 64), 1.0)
+    for im in loaded:
+        assert len(im.pts_cam) == n_world - 1  # the behind point is culled
+        assert np.all(im.pts_cam[:, 2] > 0)
+
+    with pytest.raises(ValueError, match="culled for non-positive depth"):
+        load_scene(scene_dir, "images", (64, 64), 1.0, min_points=n_world)
+
+
+def test_llff_incompatible_camera_model_rejected(tmp_path):
+    """A PINHOLE camera (fx, fy, cx, cy) under SIMPLE_* indexing would be
+    silently misread (fy as cx, cx as cy) — the loader must reject the
+    layout loudly, not warn about 'distortion'."""
+    from mine_tpu.data import colmap
+    from mine_tpu.data.llff import load_scene
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=3)
+    scene_dir = os.path.join(tmp_path, "scene_a")
+    (cameras, images, pts), sparse = _scene_model(scene_dir)
+    cam = cameras[1]
+    cameras[1] = colmap.Camera(
+        cam.id, "PINHOLE", cam.width, cam.height,
+        np.array([cam.params[0], cam.params[0], cam.params[1], cam.params[2]]),
+    )
+    colmap.write_cameras_binary(cameras, os.path.join(sparse, "cameras.bin"))
+    with pytest.raises(ValueError, match="PINHOLE.*cannot read"):
+        load_scene(scene_dir, "images", (64, 64), 1.0)
+
+
+def test_llff_corrupt_track_fails_loudly(tmp_path):
+    """A track referencing a missing 3D point id is a corrupt model: the
+    error names the image, not a bare KeyError."""
+    from mine_tpu.data import colmap
+    from mine_tpu.data.llff import load_scene
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=3)
+    scene_dir = os.path.join(tmp_path, "scene_a")
+    (cameras, images, pts), sparse = _scene_model(scene_dir)
+    m = images[1]
+    images[1] = colmap.ImageMeta(
+        m.id, m.qvec, m.tvec, m.camera_id, m.name,
+        np.concatenate([m.xys, [[1.0, 1.0]]]),
+        np.concatenate([m.point3d_ids, [987654]]),
+    )
+    colmap.write_images_binary(images, os.path.join(sparse, "images.bin"))
+    with pytest.raises(ValueError, match="987654.*absent from points3D"):
+        load_scene(scene_dir, "images", (64, 64), 1.0)
+
+
+def test_llff_empty_track_fails_loudly(tmp_path):
+    """An image with zero tracked points (all ids -1) fails with an
+    actionable count, not a crash downstream."""
+    from mine_tpu.data import colmap
+    from mine_tpu.data.llff import load_scene
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=3)
+    scene_dir = os.path.join(tmp_path, "scene_a")
+    (cameras, images, pts), sparse = _scene_model(scene_dir)
+    m = images[2]
+    images[2] = colmap.ImageMeta(
+        m.id, m.qvec, m.tvec, m.camera_id, m.name, m.xys,
+        np.full_like(m.point3d_ids, -1),
+    )
+    colmap.write_images_binary(images, os.path.join(sparse, "images.bin"))
+    with pytest.raises(ValueError, match="0 usable points"):
+        load_scene(scene_dir, "images", (64, 64), 1.0)
+
+
+def test_llff_varying_image_sizes_on_disk(tmp_path):
+    """Stored images of DIFFERENT sizes in one scene (real datasets mix
+    resolutions after manual cleanup): per-image ratios must keep the
+    projection consistent — focal scales with the stored size, so all
+    images' K agree after resize to the common target."""
+    from PIL import Image
+
+    from mine_tpu.data.llff import load_scene
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=3)
+    scene_dir = os.path.join(tmp_path, "scene_a")
+    p = os.path.join(scene_dir, "images", "view_001.png")
+    img = Image.open(p)
+    img.resize((img.width * 2, img.height * 2), Image.BICUBIC).save(p)
+
+    loaded = load_scene(scene_dir, "images", (64, 64), 1.0)
+    assert len(loaded) == 3
+    assert all(im.img.shape == (64, 64, 3) for im in loaded)
+    # upscaled-on-disk image: stored pixels are 2x the COLMAP camera's
+    # resolution, so its ratio doubles and K halves relative to the others
+    np.testing.assert_allclose(loaded[1].k[0, 0], loaded[0].k[0, 0] / 2, rtol=1e-6)
+    np.testing.assert_allclose(loaded[2].k, loaded[0].k, rtol=1e-6)
+
+
+def test_llff_single_image_val_fails_loudly(tmp_path):
+    """A val folder with one image cannot form (src, tgt) pairs: loud
+    actionable error, not an empty epoch or a modulo crash."""
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=5)
+    scene = os.path.join(tmp_path, "scene_a")
+    os.rename(os.path.join(scene, "images"), os.path.join(scene, "images_val"))
+    for name in sorted(os.listdir(os.path.join(scene, "images_val")))[1:]:
+        os.remove(os.path.join(scene, "images_val", name))
+    with pytest.raises(ValueError, match="1 image.*need >= 2"):
+        LLFFDataset(_llff_cfg(str(tmp_path)), "val", global_batch=2)
+
+
 def test_llff_warp_consistency(llff_root):
     """End-to-end geometry: warping the src view's far plane into the target
     camera with the dataset's own K/G reproduces the target view where the

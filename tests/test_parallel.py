@@ -277,6 +277,82 @@ def test_data_parallel_step_matches_single_device():
         )
 
 
+@pytest.mark.parametrize("path", ["src", "volume"])
+def test_plane_sharded_grads_match_dense_elementwise(rng, path):
+    """Elementwise-tight (<=1e-5) sharded-vs-dense GRADIENT equivalence on a
+    no-BN, no-discrete-op subgraph: compositing + L2 (VERDICT r4 #4).
+
+    The full-step equivalence tests above accept 5% per-leaf update-norm
+    deviation (fp selection noise from discrete ops is real there), which
+    could hide a subtly wrong collective scaling on small leaves. This
+    subgraph has no discrete selections, so every collective in the plane-
+    sharded backward — the all_gather prefix transpose, the ppermute halo
+    transpose, the psum-of-replicated-cotangent — must reproduce the dense
+    gradient to fp-reassociation precision, elementwise."""
+    from mine_tpu.ops import inverse_3x3, plane_volume_rendering, render_src
+    from mine_tpu.parallel import sharded_render_src
+
+    b, s, h, w = 1, 8, 6, 10
+    rgb = jnp.asarray(rng.uniform(size=(b, s, h, w, 3)).astype(np.float32))
+    sigma = jnp.asarray(
+        rng.uniform(0.1, 2.0, size=(b, s, h, w, 1)).astype(np.float32)
+    )
+    target = jnp.asarray(rng.uniform(size=(b, h, w, 3)).astype(np.float32))
+
+    if path == "src":
+        k = jnp.asarray(
+            np.array([[12.0, 0, 5.0], [0, 12.0, 4.0], [0, 0, 1.0]], np.float32)
+        )[None]
+        k_inv = inverse_3x3(k)
+        third = jnp.asarray(
+            np.linspace(1.0, 0.1, s, dtype=np.float32)
+        )[None]  # disparity (B, S)
+
+        def dense_loss(r, sg, d):
+            rgb_out, depth_out, _, _ = render_src(r, sg, d, k_inv)
+            return jnp.sum((rgb_out - target) ** 2) + 0.1 * jnp.sum(depth_out**2)
+
+        def shard_loss(r, sg, d):
+            rgb_out, depth_out, _, _ = sharded_render_src(
+                r, sg, d, k_inv, "plane"
+            )
+            return jnp.sum((rgb_out - target) ** 2) + 0.1 * jnp.sum(depth_out**2)
+    else:
+        z = np.broadcast_to(
+            np.linspace(1.0, 4.0, s)[None, :, None, None, None], (b, s, h, w, 1)
+        )
+        xy = rng.uniform(size=(b, s, h, w, 2)) * 0.05
+        third = jnp.asarray(np.concatenate([xy, z], -1).astype(np.float32))
+
+        def dense_loss(r, sg, x):
+            rgb_out, depth_out, _, _ = plane_volume_rendering(r, sg, x)
+            return jnp.sum((rgb_out - target) ** 2) + 0.1 * jnp.sum(depth_out**2)
+
+        def shard_loss(r, sg, x):
+            rgb_out, depth_out, _, _ = sharded_plane_volume_rendering(
+                r, sg, x, "plane"
+            )
+            return jnp.sum((rgb_out - target) ** 2) + 0.1 * jnp.sum(depth_out**2)
+
+    want = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))(rgb, sigma, third)
+
+    mesh = _plane_mesh(4)
+    # the per-device loss value is already replicated (compositing outputs
+    # come back psum-replicated), so grad of the local loss is the full
+    # gradient — no pmean wrapper, which would rescale cotangents
+    grad_fn = shard_map(
+        jax.grad(shard_loss, argnums=(0, 1, 2)),
+        mesh=mesh,
+        in_specs=(P(None, "plane"),) * 3,
+        out_specs=(P(None, "plane"),) * 3,
+    )
+    got = jax.jit(grad_fn)(rgb, sigma, third)
+    for g_, w_, name in zip(got, want, ["d_rgb", "d_sigma", "d_third"]):
+        np.testing.assert_allclose(
+            np.asarray(g_), np.asarray(w_), rtol=1e-5, atol=1e-5, err_msg=name
+        )
+
+
 @pytest.mark.parametrize("use_alpha", [False, True])
 @pytest.mark.parametrize("is_bg_depth_inf", [False, True])
 def test_sharded_render_src_matches_unsharded(rng, use_alpha, is_bg_depth_inf):

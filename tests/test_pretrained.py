@@ -227,3 +227,100 @@ def test_init_state_consumes_backbone_path(tmp_path):
         state.batch_stats["backbone"]["SyncBatchNorm_0"]["BatchNorm_0"]["var"]
     )
     np.testing.assert_allclose(got_var, tm.bn1.running_var.numpy(), atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Vendored-manifest fidelity (VERDICT r4 #8): the exact public state_dict
+# layouts of torchvision resnet{18,50}, torchvision vgg16.features, and the
+# lpips vgg lin checkpoint live as fixture JSONs (tools/
+# gen_pretrained_manifests.py documents the transcription sources). The
+# converters must map EVERY manifest key (minus documented drops) onto the
+# real flax trees — closing the "only ever parsed builder-written twins"
+# naming risk (PARITY.md) without egress.
+
+import json  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+_FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _manifest(name):
+    return json.loads((_FIXTURES / name).read_text())
+
+
+def _zeros_sd(manifest):
+    return {k: np.zeros(shape, np.float32) for k, shape in manifest.items()}
+
+
+@pytest.mark.parametrize("num_layers", [18, 50])
+def test_resnet_manifest_matches_torch_twin(num_layers):
+    """Triangle check: the vendored manifest (transcribed from torchvision
+    sources) and the executable torch twin agree on every key and shape —
+    if either mis-transcribed the public layout, they would disagree."""
+    man = {
+        k: tuple(s)
+        for k, s in _manifest(
+            f"torchvision_resnet{num_layers}_state_dict.json"
+        ).items()
+        if not k.startswith("fc.")  # the twin is headless
+    }
+    twin = {
+        k: tuple(v.shape)
+        for k, v in _TorchPyramid(num_layers).state_dict().items()
+    }
+    assert man == twin
+
+
+@pytest.mark.parametrize("num_layers", [18, 50])
+def test_resnet_converter_maps_entire_manifest_onto_encoder_tree(num_layers):
+    """torch_resnet_to_flax over the exact torchvision key set must produce
+    exactly the flax encoder's variable tree: same keys, same shapes, no
+    extras, nothing missing (abstract init — no FLOPs)."""
+    from flax import traverse_util
+
+    manifest = _manifest(f"torchvision_resnet{num_layers}_state_dict.json")
+    out = torch_resnet_to_flax(_zeros_sd(manifest), num_layers)
+
+    enc = ResNetEncoder(num_layers=num_layers, dtype=jnp.float32)
+    variables = jax.eval_shape(
+        lambda: enc.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 96, 3)), False
+        )
+    )
+    want = {}
+    for coll in ("params", "batch_stats"):
+        for path, leaf in traverse_util.flatten_dict(variables[coll]).items():
+            want[f"{coll}/backbone/" + "/".join(path)] = tuple(leaf.shape)
+    assert {k: v.shape for k, v in out.items()} == want
+
+
+def test_lpips_manifest_roundtrip(tmp_path):
+    """state_dicts_to_arrays over the exact vgg16.features + lpips lin key
+    sets: 13 convs in NUMERIC feature order (a string sort would scramble
+    features.10 before features.2), 5 lin layers, and the saved .npz loads
+    through the runtime's strict load_lpips_params."""
+    import random
+
+    from tools.convert_lpips import _save, state_dicts_to_arrays
+
+    from mine_tpu.losses.lpips import load_lpips_params
+
+    vgg_man = _manifest("torchvision_vgg16_features_state_dict.json")
+    lin_man = _manifest("lpips_vgg_lin_state_dict.json")
+    # scrambled insertion order: the mapping must sort, not trust the dict
+    vgg_items = list(_zeros_sd(vgg_man).items())
+    random.Random(3).shuffle(vgg_items)
+    conv_w, conv_b, lin_w = state_dicts_to_arrays(
+        dict(vgg_items), _zeros_sd(lin_man)
+    )
+    assert [w.shape[0] for w in conv_w] == [
+        64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512
+    ]
+    assert [w.shape[1] for w in conv_w[:3]] == [3, 64, 64]
+    assert [b.shape[0] for b in conv_b] == [w.shape[0] for w in conv_w]
+    assert [w.shape[1] for w in lin_w] == [64, 128, 256, 512, 512]
+
+    path = str(tmp_path / "lpips_vgg.npz")
+    _save(path, conv_w, conv_b, lin_w)
+    params = load_lpips_params(path)
+    assert len(params["conv_w"]) == 13 and len(params["lin_w"]) == 5

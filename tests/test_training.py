@@ -263,6 +263,73 @@ def test_evaluate_cli(tmp_path):
         evaluate_cli.main(["--checkpoint", empty])
 
 
+def test_per_example_losses_decompose_exactly(rng):
+    """per_example=True loss_dict vectors must reproduce the scalar path
+    under masking: metrics of a 2-example batch == weighted mean of the
+    per-example vectors of the same batch wrap-padded to 4 with duplicates
+    and weights [1,1,0,0] (VERDICT r4 #5 — the val pad-mask correctness
+    reduces to exactly this decomposition)."""
+    from mine_tpu.training import loss_fcn_per_scale
+
+    cfg = TINY
+    b, s, h, w = 2, 3, 64, 64
+    batch_np = make_synthetic_batch(b, h, w, n_points=16, seed=3)
+    batch_np.pop("src_depth")
+    # wrap-pad: slots 2,3 duplicate slots 0,1 (np.resize semantics)
+    padded_np = {k: np.concatenate([v, v]) for k, v in batch_np.items()}
+    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+    padded = {k: jnp.asarray(v) for k, v in padded_np.items()}
+    mpi_np = np.concatenate(
+        [rng.uniform(size=(b, s, h, w, 3)),
+         rng.uniform(0.1, 2.0, size=(b, s, h, w, 1))], axis=-1
+    ).astype(np.float32)
+    disparity_np = np.stack([np.linspace(1.0, 0.1, s, dtype=np.float32)] * b)
+
+    want, _, _ = loss_fcn_per_scale(
+        cfg, 0, batch, jnp.asarray(mpi_np), jnp.asarray(disparity_np), None,
+        is_val=True, lpips_params=None,
+    )
+    got_vec, _, _ = loss_fcn_per_scale(
+        cfg, 0, padded, jnp.asarray(np.concatenate([mpi_np, mpi_np])),
+        jnp.asarray(np.concatenate([disparity_np, disparity_np])), None,
+        is_val=True, lpips_params=None, per_example=True,
+    )
+    weight = jnp.asarray([1.0, 1.0, 0.0, 0.0])
+    for k, v in want.items():
+        assert got_vec[k].shape == (2 * b,), k
+        masked = float(jnp.sum(got_vec[k] * weight) / jnp.sum(weight))
+        assert masked == pytest.approx(float(v), rel=1e-5, abs=1e-6), k
+
+
+@pytest.mark.slow
+def test_eval_step_masks_wrap_padded_slots():
+    """make_eval_step with batch['eval_weight']: a batch whose second slot
+    is a weight-0 duplicate must report exactly the metrics of the genuine
+    single-example batch, and eval_examples must count only genuine slots."""
+    cfg = TINY
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    batch_np = make_synthetic_batch(1, cfg.data.img_h, cfg.data.img_w,
+                                    n_points=32, seed=5)
+    batch_np.pop("src_depth")
+    genuine = {k: jnp.asarray(v) for k, v in batch_np.items()}
+    padded = {k: jnp.asarray(np.concatenate([v, v])) for k, v in batch_np.items()}
+    padded["eval_weight"] = jnp.asarray([1.0, 0.0])
+
+    eval_step = jax.jit(make_eval_step(cfg, model))
+    key = jax.random.PRNGKey(2)
+    want, _ = eval_step(state, genuine, key)
+    got, _ = eval_step(state, padded, key)
+    assert float(got["eval_examples"]) == pytest.approx(1.0)
+    for k in want:
+        if k == "eval_examples":
+            continue
+        assert float(got[k]) == pytest.approx(
+            float(want[k]), rel=1e-4, abs=1e-5
+        ), k
+
+
 def test_loss_per_scale_use_alpha_path(rng):
     """The alpha-compositing branch (mpi.use_alpha, reference
     mpi_rendering.py:7-20) runs the full per-scale loss graph: no src-RGB

@@ -26,6 +26,25 @@ import argparse
 import numpy as np
 
 
+def state_dicts_to_arrays(vgg_sd: dict, lin_sd: dict):
+    """Pure mapping: (vgg16-features state_dict, lpips lin state_dict) ->
+    (conv_w, conv_b, lin_w) lists in network order. Values may be torch
+    tensors or numpy arrays. Numeric sort on the feature index — a plain
+    string sort would put features.10 before features.2."""
+
+    def to_np(v):
+        return np.asarray(getattr(v, "numpy", lambda: v)())
+
+    conv_keys = sorted(
+        {k.rsplit(".", 1)[0] for k in vgg_sd if k.endswith(".weight")},
+        key=lambda k: int(k.split(".")[-1]) if k.split(".")[-1].isdigit() else int(k.split(".")[0]),
+    )
+    conv_w = [to_np(vgg_sd[k + ".weight"]) for k in conv_keys]
+    conv_b = [to_np(vgg_sd[k + ".bias"]) for k in conv_keys]
+    lin_w = [to_np(lin_sd[k]) for k in sorted(lin_sd) if "model" in k or "weight" in k]
+    return conv_w, conv_b, lin_w
+
+
 def _save(out: str, conv_w, conv_b, lin_w) -> None:
     arrays = {}
     for i, (w, b) in enumerate(zip(conv_w, conv_b)):
@@ -49,13 +68,7 @@ def main() -> None:
     if args.vgg_state and args.lin_state:
         vgg_sd = torch.load(args.vgg_state, map_location="cpu")
         lin_sd = torch.load(args.lin_state, map_location="cpu")
-        conv_keys = sorted(
-            {k.rsplit(".", 1)[0] for k in vgg_sd if k.endswith(".weight")},
-            key=lambda k: int(k.split(".")[-1]) if k.split(".")[-1].isdigit() else int(k.split(".")[0]),
-        )
-        conv_w = [vgg_sd[k + ".weight"].numpy() for k in conv_keys]
-        conv_b = [vgg_sd[k + ".bias"].numpy() for k in conv_keys]
-        lin_w = [lin_sd[k].numpy() for k in sorted(lin_sd) if "model" in k or "weight" in k]
+        conv_w, conv_b, lin_w = state_dicts_to_arrays(vgg_sd, lin_sd)
     else:
         import lpips as lpips_pkg
 

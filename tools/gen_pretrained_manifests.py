@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Generate vendored state_dict manifests (key -> shape) for the pretrained
+converters' fixture tests (VERDICT r4 #8).
+
+The layouts are transcribed from the public, stable torchvision/lpips
+sources — NOT from this repo's converters (that would be circular):
+
+  * torchvision resnet{18,50}: torchvision/models/resnet.py — stem
+    conv1/bn1, BasicBlock (2 convs) or Bottleneck (3 convs, x4 expansion),
+    downsample conv+bn on a stage's first block when the shape changes
+    (for bottlenecks that includes layer1.0), fc head, and BatchNorm's
+    num_batches_tracked bookkeeping entries. These are the exact keys of
+    `resnet50(weights=IMAGENET1K_*).state_dict()` — what the reference
+    downloads at construction (resnet_encoder.py:56-60).
+  * torchvision vgg16 .features: conv indices 0,2,5,7,10,12,14,17,19,21,
+    24,26,28 (vgg.py cfg "D"), weight+bias each — the backbone LPIPS uses.
+  * lpips vgg.pth: lin{0..4}.model.1.weight, (1, C, 1, 1) non-negative 1x1
+    kernels over channels [64, 128, 256, 512, 512] (lpips/lpips.py:LPIPS,
+    linear layers; what synthesis_task.py:93 loads).
+
+Run: python tools/gen_pretrained_manifests.py  (writes tests/fixtures/)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_STAGES = {18: (2, 2, 2, 2), 50: (3, 4, 6, 3)}
+_PLANES = (64, 128, 256, 512)
+
+
+def resnet_manifest(num_layers: int) -> dict[str, list[int]]:
+    bottleneck = num_layers >= 50
+    expansion = 4 if bottleneck else 1
+    m: dict[str, list[int]] = {}
+
+    def bn(prefix: str, c: int) -> None:
+        m[f"{prefix}.weight"] = [c]
+        m[f"{prefix}.bias"] = [c]
+        m[f"{prefix}.running_mean"] = [c]
+        m[f"{prefix}.running_var"] = [c]
+        m[f"{prefix}.num_batches_tracked"] = []
+
+    m["conv1.weight"] = [64, 3, 7, 7]
+    bn("bn1", 64)
+    inplanes = 64
+    for s, n_blocks in enumerate(_STAGES[num_layers]):
+        planes = _PLANES[s]
+        stride = 1 if s == 0 else 2
+        for b in range(n_blocks):
+            pre = f"layer{s + 1}.{b}"
+            if bottleneck:
+                m[f"{pre}.conv1.weight"] = [planes, inplanes, 1, 1]
+                bn(f"{pre}.bn1", planes)
+                m[f"{pre}.conv2.weight"] = [planes, planes, 3, 3]
+                bn(f"{pre}.bn2", planes)
+                m[f"{pre}.conv3.weight"] = [planes * 4, planes, 1, 1]
+                bn(f"{pre}.bn3", planes * 4)
+            else:
+                m[f"{pre}.conv1.weight"] = [planes, inplanes, 3, 3]
+                bn(f"{pre}.bn1", planes)
+                m[f"{pre}.conv2.weight"] = [planes, planes, 3, 3]
+                bn(f"{pre}.bn2", planes)
+            if b == 0 and (stride != 1 or inplanes != planes * expansion):
+                m[f"{pre}.downsample.0.weight"] = [
+                    planes * expansion, inplanes, 1, 1
+                ]
+                bn(f"{pre}.downsample.1", planes * expansion)
+            inplanes = planes * expansion
+        # blocks after the first see the expanded width
+    m["fc.weight"] = [1000, inplanes]
+    m["fc.bias"] = [1000]
+    return m
+
+
+_VGG16_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+_VGG16_WIDTHS = (64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512)
+_LPIPS_CHNS = (64, 128, 256, 512, 512)
+
+
+def vgg16_features_manifest() -> dict[str, list[int]]:
+    m: dict[str, list[int]] = {}
+    cin = 3
+    for idx, cout in zip(_VGG16_CONV_IDX, _VGG16_WIDTHS):
+        m[f"features.{idx}.weight"] = [cout, cin, 3, 3]
+        m[f"features.{idx}.bias"] = [cout]
+        cin = cout
+    return m
+
+
+def lpips_lin_manifest() -> dict[str, list[int]]:
+    return {
+        f"lin{i}.model.1.weight": [1, c, 1, 1]
+        for i, c in enumerate(_LPIPS_CHNS)
+    }
+
+
+def main() -> None:
+    fixtures = os.path.join(os.path.dirname(__file__), "..", "tests", "fixtures")
+    os.makedirs(fixtures, exist_ok=True)
+    out = {
+        "torchvision_resnet18_state_dict.json": resnet_manifest(18),
+        "torchvision_resnet50_state_dict.json": resnet_manifest(50),
+        "torchvision_vgg16_features_state_dict.json": vgg16_features_manifest(),
+        "lpips_vgg_lin_state_dict.json": lpips_lin_manifest(),
+    }
+    for name, manifest in out.items():
+        path = os.path.join(fixtures, name)
+        with open(path, "w") as f:
+            json.dump(manifest, f, indent=1)
+        print(f"wrote {path}: {len(manifest)} keys")
+
+
+if __name__ == "__main__":
+    main()
