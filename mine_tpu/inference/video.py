@@ -152,24 +152,62 @@ def write_video(frames: np.ndarray, path: str, fps: int = 30) -> str:
     return frame_dir
 
 
-@partial(jax.jit, static_argnums=0)
-def predict_blended_mpi(
-    cfg: Config, variables: Any, img: Array, disparity: Array, k: Array
-) -> tuple[Array, Array]:
-    """One network pass + src RGB blending (image_to_video.py:136-156):
-    plane RGB is replaced by the real source pixels wherever the source view
-    sees them; network RGB survives only where occluded. Module-level jit with
-    cfg static, so repeated VideoGenerators with one config compile once."""
-    model = build_model(cfg)
-    mpi = model.apply(variables, img, disparity, False)[0]
-    mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
+def _blend_src_rgb(
+    cfg: Config, img: Array, mpi_rgb: Array, mpi_sigma: Array,
+    disparity: Array, k: Array,
+) -> Array:
+    """Src RGB blending (image_to_video.py:145-156): plane RGB is replaced
+    by the real source pixels wherever the source view sees them; network
+    RGB survives only where occluded. The single blend home for both the
+    single-pass and coarse-to-fine predicts."""
     _, _, blend_weights, _ = ops.render_src(
         mpi_rgb, mpi_sigma, disparity, ops.inverse_3x3(k),
         use_alpha=cfg.mpi.use_alpha,
         is_bg_depth_inf=cfg.mpi.is_bg_depth_inf,
     )
-    mpi_rgb = blend_weights * img[:, None] + (1.0 - blend_weights) * mpi_rgb
+    return blend_weights * img[:, None] + (1.0 - blend_weights) * mpi_rgb
+
+
+@partial(jax.jit, static_argnums=0)
+def predict_blended_mpi(
+    cfg: Config, variables: Any, img: Array, disparity: Array, k: Array
+) -> tuple[Array, Array]:
+    """One network pass + src RGB blending (image_to_video.py:136-156).
+    Module-level jit with cfg static, so repeated VideoGenerators with one
+    config compile once."""
+    model = build_model(cfg)
+    mpi = model.apply(variables, img, disparity, False)[0]
+    mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
+    mpi_rgb = _blend_src_rgb(cfg, img, mpi_rgb, mpi_sigma, disparity, k)
     return mpi_rgb, mpi_sigma
+
+
+@partial(jax.jit, static_argnums=0)
+def predict_blended_mpi_c2f(
+    cfg: Config, variables: Any, img: Array, k: Array
+) -> tuple[Array, Array, Array]:
+    """Coarse-to-fine predict (two network passes over coarse + PDF-refined
+    planes, training/step.py forward_coarse_to_fine) + src RGB blending.
+    Returns (mpi_rgb, mpi_sigma, merged_disparity) — the plane count is
+    num_bins_coarse + num_bins_fine, so the caller must render with the
+    RETURNED disparity, not its own list. The reference ships this path
+    dead (params_default.yaml:30) and its inference app has no analog;
+    evaluating a c2f-trained model any other way would score a different
+    operating point than the one trained."""
+    from mine_tpu.training.step import forward_coarse_to_fine
+
+    model = build_model(cfg)
+    fixed_cfg = cfg.replace(**{"mpi.fix_disparity": True})
+    mpis, disparity, _ = forward_coarse_to_fine(
+        fixed_cfg, model, variables["params"], variables["batch_stats"],
+        img, ops.inverse_3x3(k),
+        key_disparity=jax.random.PRNGKey(0),
+        key_fine=jax.random.PRNGKey(1), train=False,
+    )
+    mpi = mpis[0]
+    mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
+    mpi_rgb = _blend_src_rgb(cfg, img, mpi_rgb, mpi_sigma, disparity, k)
+    return mpi_rgb, mpi_sigma, disparity
 
 
 class VideoGenerator:
@@ -189,16 +227,23 @@ class VideoGenerator:
         self.img = prepare_image(image, h, w)
         self.k = jnp.asarray(fov_intrinsics(h, w, fov_deg))[None]
 
-        # Inference planes are deterministic: the fix_disparity branch of the
-        # shared sampler (linspace, or the explicit bin list when configured
-        # — synthesis_task.py:36-45).
-        fixed_cfg = cfg.replace(**{"mpi.fix_disparity": True})
-        self.disparity = make_disparity_list(fixed_cfg, jax.random.PRNGKey(0), 1)
-
         variables = {"params": params, "batch_stats": batch_stats}
-        self.mpi_rgb, self.mpi_sigma = predict_blended_mpi(
-            cfg, variables, self.img, self.disparity, self.k
-        )
+        if cfg.mpi.num_bins_fine > 0:
+            # a c2f-trained model must be rendered at its merged plane list
+            self.mpi_rgb, self.mpi_sigma, self.disparity = (
+                predict_blended_mpi_c2f(cfg, variables, self.img, self.k)
+            )
+        else:
+            # Inference planes are deterministic: the fix_disparity branch
+            # of the shared sampler (linspace, or the explicit bin list when
+            # configured — synthesis_task.py:36-45).
+            fixed_cfg = cfg.replace(**{"mpi.fix_disparity": True})
+            self.disparity = make_disparity_list(
+                fixed_cfg, jax.random.PRNGKey(0), 1
+            )
+            self.mpi_rgb, self.mpi_sigma = predict_blended_mpi(
+                cfg, variables, self.img, self.disparity, self.k
+            )
 
     def render_poses(self, poses: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Render (N, 4, 4) poses -> (rgb (N,H,W,3) float [0,1],

@@ -244,6 +244,32 @@ def test_video_generator_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_video_generator_coarse_to_fine_dispatch():
+    """With mpi.num_bins_fine > 0 the generator runs the two-pass c2f
+    predict and renders at the MERGED plane list (coarse + fine) — the
+    reference's inference app has no analog for its (dead) c2f path."""
+    from mine_tpu.training.optimizer import make_optimizer
+    from mine_tpu.training.step import build_model, init_state
+
+    cfg = _small_cfg().replace(**{"mpi.num_bins_fine": 4})
+    model = build_model(cfg)
+    state = init_state(
+        cfg, model, make_optimizer(cfg, 1), jax.random.PRNGKey(0)
+    )
+    img, _ = _render_view(128, 128, _intrinsics(128, 128), np.zeros(3), 0.7)
+    gen = VideoGenerator(cfg, state.params, state.batch_stats, to_uint8(img))
+    assert gen.disparity.shape == (1, 8)  # 4 coarse + 4 fine, merged
+    assert gen.mpi_rgb.shape[1] == 8
+    # merged planes stay strictly descending in disparity (near -> far, the
+    # compositing order every renderer assumes)
+    d = np.asarray(gen.disparity)[0]
+    assert np.all(np.diff(d) < 0)
+    poses = poses_from_offsets(np.array([[0.01, 0.0, 0.0]]))
+    rgb, disp = gen.render_poses(poses)
+    assert np.isfinite(rgb).all() and np.isfinite(disp).all()
+
+
+@pytest.mark.slow
 def test_infer_cli(tmp_path, monkeypatch):
     """`python -m mine_tpu.infer` writes one rgb + one disp video per preset
     trajectory (shrunk to 4 frames for test speed)."""

@@ -353,6 +353,57 @@ def test_plane_sharded_grads_match_dense_elementwise(rng, path):
         )
 
 
+@pytest.mark.slow
+def test_parallel_eval_step_weighted_mean_exact_under_sharding():
+    """make_parallel_eval_step + eval_weight on the 8-device data mesh: the
+    psum-of-numerator/denominator reduction must reproduce the unsharded
+    genuine-only metrics even when every pad slot lands on a different
+    shard than its duplicate (a pmean of per-shard weighted means would
+    NOT — shards carry unequal genuine counts)."""
+    from mine_tpu.parallel import make_parallel_eval_step
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "model.num_layers": 18,
+        "model.dtype": "float32", "mpi.num_bins_coarse": 2,
+        "mpi.fix_disparity": True,
+    })
+    import optax
+
+    tx = optax.sgd(0.1)
+    batch_np = make_synthetic_batch(4, 128, 128, n_points=16, seed=11)
+    batch_np.pop("src_depth")
+
+    model1 = build_model(cfg)
+    state1 = init_state(cfg, model1, tx, jax.random.PRNGKey(0))
+    from mine_tpu.training import make_eval_step
+
+    key = jax.random.PRNGKey(4)
+    want, _ = jax.jit(make_eval_step(cfg, model1))(
+        state1, {k: jnp.asarray(v) for k, v in batch_np.items()}, key
+    )
+
+    # pad to 8: slots 4-7 duplicate 0-3 with weight 0 — after P("data")
+    # sharding each device holds ONE example, so 4 shards are all-genuine
+    # and 4 are all-pad: maximally unequal per-shard weight sums
+    padded = {k: np.concatenate([v, v]) for k, v in batch_np.items()}
+    padded["eval_weight"] = np.array([1.0] * 4 + [0.0] * 4, np.float32)
+
+    mesh = make_mesh(data_parallel=8)
+    model8 = build_model(cfg, axis_name=DATA_AXIS)
+    state8 = init_state(cfg, model8, tx, jax.random.PRNGKey(0))
+    state8 = replicate_state(state8, mesh)
+    eval8 = make_parallel_eval_step(cfg, model8, mesh)
+    got, _ = eval8(state8, shard_batch(mesh, padded), key)
+
+    assert float(got["eval_examples"]) == pytest.approx(4.0)
+    for k in want:
+        if k == "eval_examples":
+            continue
+        assert float(got[k]) == pytest.approx(
+            float(want[k]), rel=2e-3, abs=1e-4
+        ), k
+
+
 @pytest.mark.parametrize("use_alpha", [False, True])
 @pytest.mark.parametrize("is_bg_depth_inf", [False, True])
 def test_sharded_render_src_matches_unsharded(rng, use_alpha, is_bg_depth_inf):

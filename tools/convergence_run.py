@@ -46,13 +46,15 @@ CROP = 16  # interior crop: border band is clamp-padding, not scene content
 
 
 def build_cfg(height: int, width: int, batch: int, num_planes: int,
-              disparity_end: float = 0.2, num_layers: int = 18):
+              disparity_end: float = 0.2, num_layers: int = 18,
+              num_bins_fine: int = 0):
     from mine_tpu.config import Config
 
     return Config().replace(**{
         "data.name": "synthetic",
         "data.img_h": height, "data.img_w": width,
         "data.per_gpu_batch_size": batch,
+        "mpi.num_bins_fine": num_bins_fine,
         "model.num_layers": num_layers,
         "model.dtype": "float32",  # CPU path; bf16 is a TPU-bench concern
         "mpi.num_bins_coarse": num_planes,
@@ -85,7 +87,9 @@ def eval_novel_pose_psnr(cfg, params, batch_stats, phase) -> dict:
 
     from mine_tpu.data.synthetic import _intrinsics, _render_view
     from mine_tpu.inference.trajectory import poses_from_offsets
-    from mine_tpu.inference.video import predict_blended_mpi, render_many
+    from mine_tpu.inference.video import (
+        predict_blended_mpi, predict_blended_mpi_c2f, render_many,
+    )
 
     h, w = cfg.data.img_h, cfg.data.img_w
     k = _intrinsics(h, w)
@@ -98,12 +102,20 @@ def eval_novel_pose_psnr(cfg, params, batch_stats, phase) -> dict:
     all_scores = []
     for ph in phases:
         src_img, _ = _render_view(h, w, k, np.zeros(3), ph)
-        mpi_rgb, mpi_sigma = predict_blended_mpi(
-            cfg, variables, jnp.asarray(src_img)[None], disparity,
-            jnp.asarray(k)[None],
-        )
+        if cfg.mpi.num_bins_fine > 0:
+            # c2f-trained models render at their merged plane list (the
+            # jitted product predict — inference/video.py)
+            mpi_rgb, mpi_sigma, disp_used = predict_blended_mpi_c2f(
+                cfg, variables, jnp.asarray(src_img)[None], jnp.asarray(k)[None]
+            )
+        else:
+            mpi_rgb, mpi_sigma = predict_blended_mpi(
+                cfg, variables, jnp.asarray(src_img)[None], disparity,
+                jnp.asarray(k)[None],
+            )
+            disp_used = disparity
         rgb, _ = render_many(
-            cfg, mpi_rgb, mpi_sigma, disparity,
+            cfg, mpi_rgb, mpi_sigma, disp_used,
             jnp.asarray(k)[None], jnp.asarray(poses_from_offsets(NOVEL_OFFSETS)),
         )
         rgb = np.asarray(rgb)
@@ -138,6 +150,11 @@ def main() -> None:
                          "(single-scene eval carries ~±1.5 dB noise)")
     ap.add_argument("--layers", type=int, default=18,
                     help="ResNet encoder depth (18/34/50/101/152)")
+    ap.add_argument("--fine-bins", type=int, default=0,
+                    help="coarse-to-fine refinement planes (mpi.num_bins_fine"
+                         "; the reference ships this path dead — "
+                         "params_default.yaml:30 — so a converging run here "
+                         "is capability evidence the reference never had)")
     ap.add_argument("--save-final", default="",
                     help="if set, serialize final {params, batch_stats} to "
                          "this path (flax msgpack) so post-run analysis — "
@@ -186,7 +203,8 @@ def main() -> None:
     )
 
     cfg = build_cfg(args.height, args.width, args.batch, args.planes,
-                    disparity_end=args.disparity_end, num_layers=args.layers)
+                    disparity_end=args.disparity_end, num_layers=args.layers,
+                    num_bins_fine=args.fine_bins)
     model = build_model(cfg)
     tx = make_optimizer(cfg, steps_per_epoch=args.steps)
     state = init_state(cfg, model, tx, jax.random.PRNGKey(cfg.training.seed))
