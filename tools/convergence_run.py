@@ -161,6 +161,11 @@ def main() -> None:
                          "e.g. the does-the-trained-net-inpaint-past-the-"
                          "src-copy-oracle check — can load the model "
                          "without retraining")
+    ap.add_argument("--init-from", default="",
+                    help="warm-start params+batch_stats from a prior run's "
+                         "--save-final msgpack (fresh optimizer/schedule; "
+                         "lets a promising short run continue without "
+                         "repeating its steps)")
     args = ap.parse_args()
     if args.steps < 1:
         ap.error("--steps must be >= 1")
@@ -208,6 +213,19 @@ def main() -> None:
     model = build_model(cfg)
     tx = make_optimizer(cfg, steps_per_epoch=args.steps)
     state = init_state(cfg, model, tx, jax.random.PRNGKey(cfg.training.seed))
+    if args.init_from:
+        from flax import serialization
+
+        with open(args.init_from, "rb") as f:
+            tree = serialization.msgpack_restore(f.read())
+        # restore onto the freshly-initialized templates so shape/dtype
+        # mismatches (wrong --planes/--layers for the artifact) fail loudly
+        state = state.replace(
+            params=serialization.from_state_dict(state.params, tree["params"]),
+            batch_stats=serialization.from_state_dict(
+                state.batch_stats, tree["batch_stats"]
+            ),
+        )
     step_fn = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
 
     os.makedirs(args.out, exist_ok=True)
