@@ -87,10 +87,21 @@ def predict_mpi_coarse_to_fine(
     )
     # per-plane scalar weight: mean over pixels (mpi_rendering.py:258)
     w = jnp.mean(weights, axis=(2, 3, 4))  # (B, S)
-    fine = sample_pdf(
-        key, disparity_coarse[:, None, :], jax.lax.stop_gradient(w)[:, None, :], s_fine
-    )[:, 0, :]  # (B, S_fine)
-    disparity_all = jnp.concatenate([disparity_coarse, fine], axis=1)
-    disparity_all = -jnp.sort(-disparity_all, axis=1)  # descending
-    disparity_all = jax.lax.stop_gradient(disparity_all)
+    disparity_all = merge_fine_disparity(key, disparity_coarse, w, s_fine)
     return predictor(src_imgs, disparity_all), disparity_all
+
+
+def merge_fine_disparity(
+    key: Array, disparity_coarse: Array, w: Array, s_fine: int
+) -> Array:
+    """PDF-refine plane placement: (B, S) coarse disparities + (B, S)
+    per-plane scalar weights -> stop-gradient (B, S + s_fine) merged list,
+    sorted descending (the compositing order). The single home of the merge
+    convention — the plane-sharded path (training/step.py) rebuilds `w`
+    with one all_gather and must stay bit-compatible with the dense twin."""
+    fine = sample_pdf(
+        key, disparity_coarse[:, None, :],
+        jax.lax.stop_gradient(w)[:, None, :], s_fine,
+    )[:, 0, :]  # (B, s_fine)
+    disparity_all = jnp.concatenate([disparity_coarse, fine], axis=1)
+    return jax.lax.stop_gradient(-jnp.sort(-disparity_all, axis=1))

@@ -51,6 +51,13 @@ def _plane_args(cfg: Config, mesh: Mesh) -> dict:
             f"mpi.num_bins_coarse={cfg.mpi.num_bins_coarse} must divide by "
             f"the plane-axis size {n_plane}"
         )
+    if cfg.mpi.num_bins_fine % n_plane:
+        # the merged coarse+fine list re-shards across the same axis
+        # (step.py forward_coarse_to_fine); both lists must chunk evenly
+        raise ValueError(
+            f"mpi.num_bins_fine={cfg.mpi.num_bins_fine} must divide by "
+            f"the plane-axis size {n_plane}"
+        )
     return {"plane_axis": PLANE_AXIS, "compositor": plane_compositor(PLANE_AXIS)}
 
 

@@ -133,16 +133,16 @@ def forward_coarse_to_fine(
     (the key must not be folded by plane index) and each device runs the
     decoder on its own S_local contiguous chunk — the activation memory of
     decoder + renderer divides by the plane-axis size (SURVEY.md §5.7).
+    Coarse-to-fine composes with the sharding: the refinement PDF is
+    per-plane scalar weights, so one (B, S) all_gather rebuilds the global
+    PDF, every device samples identical fine planes, and the merged list
+    re-shards — both plane counts must divide the plane-axis size
+    (validated in parallel/data_parallel.py).
     """
     b, h, w, _ = src_img.shape
     disparity = make_disparity_list(cfg, key_disparity, b)
+    disparity_full = disparity  # full-S list, identical on all plane devices
     if plane_axis is not None:
-        if cfg.mpi.num_bins_fine > 0:
-            raise NotImplementedError(
-                "coarse-to-fine plane refinement needs the global plane PDF; "
-                "it is not supported under plane sharding (and no shipped "
-                "reference config enables it, params_default.yaml:30)"
-            )
         n_plane = lax.axis_size(plane_axis)
         s_local = cfg.mpi.num_bins_coarse // n_plane
         start = lax.axis_index(plane_axis) * s_local
@@ -166,7 +166,61 @@ def forward_coarse_to_fine(
             stats_cell[0] = updates["batch_stats"]
         return out
 
-    if cfg.mpi.num_bins_fine > 0:
+    if cfg.mpi.num_bins_fine > 0 and plane_axis is not None:
+        # Plane-sharded coarse-to-fine: the refinement PDF is per-plane
+        # SCALAR weights (mean compositing weight per plane — the same
+        # statistic the dense path uses, mpi_rendering.py:258), so the only
+        # cross-device traffic is a (B, S_local) -> (B, S) all_gather —
+        # the "ship statistics, not activations" discipline of
+        # parallel/plane_sharding.py extended to plane placement. Every
+        # device then samples IDENTICAL fine disparities (key_fine is
+        # shared across plane devices — see the key-split rationale in
+        # loss_fcn), sorts the identical merged list, and re-slices its
+        # chunk of the new (S_coarse + S_fine)-plane axis.
+        from mine_tpu.models.mpi import merge_fine_disparity
+        from mine_tpu.parallel.plane_sharding import (
+            sharded_plane_volume_rendering,
+        )
+
+        assert key_fine is not None, "coarse-to-fine sampling needs a PRNG key"
+        n_plane = lax.axis_size(plane_axis)
+        # floor division + dynamic_slice clamping would otherwise render a
+        # silently wrong plane subset for non-dividing counts (the
+        # production path validates in parallel/data_parallel.py; direct
+        # callers must hit a loud error too)
+        if cfg.mpi.num_bins_coarse % n_plane or cfg.mpi.num_bins_fine % n_plane:
+            raise ValueError(
+                f"plane-sharded coarse-to-fine needs both num_bins_coarse="
+                f"{cfg.mpi.num_bins_coarse} and num_bins_fine="
+                f"{cfg.mpi.num_bins_fine} to divide the plane-axis size "
+                f"{n_plane}"
+            )
+        coarse = lax.stop_gradient(predictor(src_img, disparity))
+        mpi0 = coarse[0]  # full-scale local chunk (B, S_local, H, W, 4)
+        grid = ops.homogeneous_pixel_grid(h, w)
+        xyz_local = ops.get_src_xyz_from_plane_disparity(
+            grid, disparity, k_src_inv
+        )
+        _, _, _, weights = sharded_plane_volume_rendering(
+            mpi0[..., 0:3], mpi0[..., 3:4], xyz_local, plane_axis,
+            cfg.mpi.is_bg_depth_inf,
+        )
+        w_local = jnp.mean(weights, axis=(2, 3, 4))  # (B, S_local)
+        w_full = lax.all_gather(
+            w_local, plane_axis, axis=1, tiled=True
+        )  # (B, S) in mesh-position order == plane order
+        disparity_all = merge_fine_disparity(
+            key_fine, disparity_full, w_full, cfg.mpi.num_bins_fine
+        )
+        s_local2 = (
+            cfg.mpi.num_bins_coarse + cfg.mpi.num_bins_fine
+        ) // n_plane
+        start2 = lax.axis_index(plane_axis) * s_local2
+        disparity = lax.dynamic_slice_in_dim(
+            disparity_all, start2, s_local2, axis=1
+        )
+        mpis = predictor(src_img, disparity)
+    elif cfg.mpi.num_bins_fine > 0:
         grid = ops.homogeneous_pixel_grid(h, w)
         xyz_coarse = ops.get_src_xyz_from_plane_disparity(grid, disparity, k_src_inv)
         mpis, disparity = predict_mpi_coarse_to_fine(
