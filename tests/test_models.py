@@ -133,3 +133,26 @@ def test_full_network_and_coarse_to_fine():
     assert d_all.shape == (b, s + 2)
     assert (jnp.diff(d_all, axis=1) <= 0).all()  # descending
     assert out[0].shape == (b, s + 2, h, w, 4)
+
+
+def test_merge_fine_disparity_places_planes_where_the_pdf_says():
+    """The shared c2f merge helper (single home of the merge convention,
+    used by both the dense and plane-sharded paths): fine planes must land
+    inside the high-weight coarse bin, the output must be sorted descending
+    (compositing order), and contain every coarse plane."""
+    from mine_tpu.models.mpi import merge_fine_disparity
+
+    coarse = jnp.asarray(np.linspace(1.0, 0.2, 5, dtype=np.float32))[None]
+    # all weight on the bin between planes 2 and 3 (disparity 0.6 -> 0.4)
+    w = jnp.asarray(np.array([[0.0, 0.0, 1.0, 1.0, 0.0]], np.float32))
+    merged = merge_fine_disparity(jax.random.PRNGKey(0), coarse, w, 4)
+    assert merged.shape == (1, 9)
+    m = np.asarray(merged)[0]
+    assert np.all(np.diff(m) < 0)  # strictly descending
+    for c in np.asarray(coarse)[0]:
+        assert np.isclose(m, c).any()  # coarse planes all survive the merge
+    fine = sorted(set(np.round(m, 6)) - set(np.round(np.asarray(coarse)[0], 6)))
+    assert len(fine) == 4
+    # sample_pdf's support spans the weighted bins (midpoint-binned around
+    # planes 2-3): every fine plane falls in the high-mass region
+    assert all(0.3 <= f <= 0.72 for f in fine)
