@@ -33,6 +33,12 @@ from PIL import Image
 from mine_tpu.config import Config
 from mine_tpu.data import colmap
 
+# near-plane cull threshold as a fraction of an image's median track depth
+# (load_scene): small enough that genuine foreground (a near occluder at
+# 1/10th the median) survives, large enough that lens-grazing COLMAP
+# artifacts (z ~ 1e-5 of scene scale) cannot reach 1/z supervision
+MIN_DEPTH_FRACTION = 0.01
+
 
 @dataclass
 class PosedImage:
@@ -75,9 +81,11 @@ def load_scene(
       * SIMPLE_RADIAL distortion is read and IGNORED exactly like the
         reference (nerf_dataset.py:154-163 uses params[0:3] only), but a
         non-trivial coefficient warns instead of silently mis-projecting.
-      * points landing behind (or on) an image's camera plane are dropped
-        from that image's track — a negative/zero depth would flow into
-        1/z disparity supervision and NaN the loss.
+      * points behind the camera OR closer than MIN_DEPTH_FRACTION of the
+        image's median track depth are dropped from that image's track — a
+        negative/zero depth would NaN the 1/z disparity supervision, and a
+        lens-grazing near outlier would dominate the exp(mean(log)) scale
+        calibration (losses/metrics.py compute_scale_factor, ADVICE r5).
       * a track referencing a 3D point id missing from points3D fails with
         the offending image, not a bare KeyError.
     """
@@ -151,12 +159,26 @@ def load_scene(
             ) from None
         pts_cam = (world @ r.T + t).astype(np.float32)  # (N, 3)
         n_tracked = len(pts_cam)
-        pts_cam = pts_cam[pts_cam[:, 2] > 1e-6]  # behind-camera culling
+        # Scene-meaningful near-plane cull, not just z > 0: COLMAP tracks
+        # occasionally triangulate a point millimeters in front of the lens,
+        # and a single z=1e-5 survivor contributes log(1/z) ~ 11.5 to
+        # compute_scale_factor's exp(mean(log...)) — one outlier can shift
+        # the whole image's scale calibration and the log-disparity loss
+        # (ADVICE r5). A point closer than a small fraction of the image's
+        # MEDIAN track depth is a reconstruction artifact, not geometry.
+        z = pts_cam[:, 2]
+        positive = z[z > 0]
+        min_depth = (
+            max(MIN_DEPTH_FRACTION * float(np.median(positive)), 1e-6)
+            if len(positive) else 1e-6
+        )
+        pts_cam = pts_cam[z > min_depth]
         if len(pts_cam) < min_points:
             raise ValueError(
                 f"{path}: {len(pts_cam)} usable points < required "
                 f"{min_points} ({n_tracked} tracked, "
-                f"{n_tracked - len(pts_cam)} culled for non-positive depth)"
+                f"{n_tracked - len(pts_cam)} culled below the scene min "
+                f"depth {min_depth:.3g})"
             )
         out.append(PosedImage(os.path.basename(scene_dir), arr, k, g, pts_cam))
     return out

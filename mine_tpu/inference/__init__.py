@@ -19,7 +19,10 @@ from mine_tpu.inference.video import (
     fov_intrinsics,
     load_video_generator,
     normalize_disparity,
+    predict_blended_mpi,
+    predict_blended_mpi_fn,
     render_many,
+    render_many_fn,
     to_uint8,
     write_video,
 )

@@ -60,8 +60,7 @@ def prepare_image(image: np.ndarray, height: int, width: int) -> Array:
     return jnp.clip(img, 0.0, 1.0)
 
 
-@partial(jax.jit, static_argnums=0)
-def render_many(
+def render_many_fn(
     cfg: Config,
     mpi_rgb: Array,
     mpi_sigma: Array,
@@ -69,14 +68,14 @@ def render_many(
     k: Array,
     poses: Array,
 ) -> tuple[Array, Array]:
-    """Render one source MPI into every pose of a trajectory.
+    """Render one source MPI into every pose of a trajectory (pure function;
+    `render_many` is its module-level jit, the serving engine compiles its
+    own per-bucket executables from this — mine_tpu/serving/engine.py).
 
     poses: (N, 4, 4) G_tgt_src stack. Returns (rgb (N, H, W, 3),
     disparity (N, H, W, 1)), all computed in one jitted on-device `lax.map`
     (the reference's per-frame python loop, image_to_video.py:227-245).
-    Intrinsics are shared between source and target (single-image app); cfg is
-    a static (hashable) argument, so each (config, trajectory length) pair
-    compiles once and the MPI/pose arrays stay runtime inputs.
+    Intrinsics are shared between source and target (single-image app).
     """
     k_inv = ops.inverse_3x3(k)
 
@@ -88,6 +87,11 @@ def render_many(
         return out["tgt_imgs_syn"][0], out["tgt_disparity_syn"][0]
 
     return lax.map(one_pose, poses)
+
+
+# cfg is a static (hashable) argument, so each (config, trajectory length)
+# pair compiles once and the MPI/pose arrays stay runtime inputs
+render_many = partial(jax.jit, static_argnums=0)(render_many_fn)
 
 
 def normalize_disparity(disparity: np.ndarray) -> np.ndarray:
@@ -168,13 +172,13 @@ def _blend_src_rgb(
     return blend_weights * img[:, None] + (1.0 - blend_weights) * mpi_rgb
 
 
-@partial(jax.jit, static_argnums=0)
-def predict_blended_mpi(
+def predict_blended_mpi_fn(
     cfg: Config, variables: Any, img: Array, disparity: Array, k: Array
 ) -> tuple[Array, Array]:
     """One network pass + src RGB blending (image_to_video.py:136-156).
-    Module-level jit with cfg static, so repeated VideoGenerators with one
-    config compile once."""
+    Pure function; `predict_blended_mpi` is its module-level jit (repeated
+    VideoGenerators with one config compile once) and the serving engine
+    AOT-compiles per-bucket executables from it (serving/engine.py)."""
     model = build_model(cfg)
     mpi = model.apply(variables, img, disparity, False)[0]
     mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
@@ -182,8 +186,10 @@ def predict_blended_mpi(
     return mpi_rgb, mpi_sigma
 
 
-@partial(jax.jit, static_argnums=0)
-def predict_blended_mpi_c2f(
+predict_blended_mpi = partial(jax.jit, static_argnums=0)(predict_blended_mpi_fn)
+
+
+def predict_blended_mpi_c2f_fn(
     cfg: Config, variables: Any, img: Array, k: Array
 ) -> tuple[Array, Array, Array]:
     """Coarse-to-fine predict (two network passes over coarse + PDF-refined
@@ -208,6 +214,11 @@ def predict_blended_mpi_c2f(
     mpi_rgb, mpi_sigma = mpi[..., 0:3], mpi[..., 3:4]
     mpi_rgb = _blend_src_rgb(cfg, img, mpi_rgb, mpi_sigma, disparity, k)
     return mpi_rgb, mpi_sigma, disparity
+
+
+predict_blended_mpi_c2f = partial(jax.jit, static_argnums=0)(
+    predict_blended_mpi_c2f_fn
+)
 
 
 class VideoGenerator:

@@ -1,0 +1,30 @@
+"""Serving subsystem: predict-once / render-many as a long-lived engine.
+
+MINE's core economic property is asymmetry (PAPER.md §1): the
+encoder-decoder runs ONCE per input image to produce an MPI, after which
+every novel view is a cheap homography warp + composite. The one-shot
+inference path (mine_tpu/inference/) already exploits this within a single
+video render; this subsystem turns it into a service:
+
+  * engine.py  — RenderEngine: AOT-compiled predict / render-many
+    executables, shape-bucketed by (H, W, S) and by padded pose count, so a
+    serving process performs a bounded number of compiles over its lifetime.
+  * cache.py   — byte-budgeted LRU cache of predicted MPIs keyed by
+    (image_digest, checkpoint_step, S): an S=32 MPI at 384x512 is ~100 MB
+    fp32, so the budget is accounted in bytes, not entries.
+  * batcher.py — micro-batching queue coalescing concurrent render requests
+    against the same cached MPI into one render-many dispatch.
+  * server.py  — stdlib ThreadingHTTPServer exposing /predict, /render,
+    /healthz, /metrics (no new dependencies).
+  * metrics.py — the serving metric set on mine_tpu.utils.metrics'
+    Prometheus-text registry.
+"""
+
+from mine_tpu.serving.batcher import MicroBatcher
+from mine_tpu.serving.cache import MPICache, MPIEntry, mpi_key
+from mine_tpu.serving.engine import RenderEngine
+from mine_tpu.serving.metrics import ServingMetrics
+
+# server.py (ServingApp, make_server, the CLI) is imported directly, not
+# re-exported here: `python -m mine_tpu.serving.server` would otherwise
+# execute the module twice (runpy's found-in-sys.modules warning)

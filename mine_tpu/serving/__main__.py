@@ -1,0 +1,6 @@
+"""`python -m mine_tpu.serving` == `python -m mine_tpu.serving.server`."""
+
+from mine_tpu.serving.server import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Micro-batching queue: coalesce concurrent renders of one MPI.
+
+The render half of the serving asymmetry is a single `lax.map` over poses
+(inference/video.py render_many_fn) — rendering 8 poses in one dispatch
+costs far less than 8 dispatches of 1 (one executable launch, one
+device->host transfer, and the pose-bucketed executables amortize identical
+warp/composite setup). When several clients orbit the same scene (the
+hot-MPI case the cache exists for), their requests arrive within
+milliseconds of each other; the batcher holds the first request back for at
+most `max_delay_ms` and folds every same-key request that arrives in that
+window into one dispatch.
+
+Shape: a single worker thread over a pending deque guarded by a condition
+variable. The worker seeds a group with the oldest request, then sweeps the
+deque for requests with the same cache key (requests for OTHER keys are
+left in place and seed later groups — coalescing never reorders work within
+a key, and a cold key cannot be starved by a hot one for longer than the
+hot group's dispatch). Results come back through per-request futures, so
+HTTP handler threads just block on their own future with a timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from mine_tpu.serving.cache import CacheKey, MPIEntry
+
+# (entry, poses (N,4,4)) -> (rgb (N,H,W,3), disp (N,H,W,1))
+RenderFn = Callable[[MPIEntry, np.ndarray], tuple[np.ndarray, np.ndarray]]
+
+
+@dataclass
+class _Pending:
+    key: CacheKey
+    entry: MPIEntry
+    poses: np.ndarray
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+
+class MicroBatcher:
+    """Single-worker coalescing dispatcher with a max-delay/max-batch policy.
+
+    max_delay_ms: how long the oldest request of a group may wait for
+      company before the group dispatches (the latency cost of coalescing —
+      bounded and configurable; 0 disables waiting entirely).
+    max_batch_poses: pose-count ceiling per dispatch; a request is only
+      absorbed if the whole group still fits. A single over-sized request
+      still dispatches alone (the engine chunks internally).
+    """
+
+    def __init__(
+        self,
+        render_fn: RenderFn,
+        max_delay_ms: float = 4.0,
+        max_batch_poses: int = 64,
+        metrics: Any | None = None,
+    ):
+        if max_batch_poses < 1:
+            raise ValueError(f"max_batch_poses must be >= 1, got {max_batch_poses}")
+        self._render_fn = render_fn
+        self.max_delay_s = max(0.0, max_delay_ms) / 1e3
+        self.max_batch_poses = int(max_batch_poses)
+        self._metrics = metrics
+        self._pending: deque[_Pending] = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._worker: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._worker is None or not self._worker.is_alive():
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._run, name="mine-serve-batcher", daemon=True
+            )
+            self._worker.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+        # fail any requests stranded by shutdown instead of hanging clients
+        with self._cond:
+            stranded = list(self._pending)
+            self._pending.clear()
+            self._gauge_locked()
+        for p in stranded:
+            p.future.set_exception(RuntimeError("batcher stopped"))
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, key: CacheKey, entry: MPIEntry, poses: np.ndarray) -> Future:
+        """Enqueue one render request; resolves to (rgb, disp) host arrays."""
+        poses = np.asarray(poses, np.float32)
+        if poses.ndim != 3 or poses.shape[1:] != (4, 4):
+            raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")
+        item = _Pending(key=key, entry=entry, poses=poses)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher is stopped")
+            self._pending.append(item)
+            self._gauge_locked()
+            self._cond.notify_all()
+        if self._metrics is not None:
+            self._metrics.batch_requests.inc()
+        return item.future
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    # -- worker --------------------------------------------------------------
+
+    def _gauge_locked(self) -> None:
+        if self._metrics is not None:
+            self._metrics.batch_queue_depth.set(len(self._pending))
+
+    def _take_group(self) -> list[_Pending] | None:
+        """Block until work or stop; return one coalesced same-key group."""
+        with self._cond:
+            while not self._pending and not self._stop:
+                self._cond.wait()
+            if not self._pending:
+                return None  # stopping and drained
+            seed = self._pending.popleft()
+            group = [seed]
+            n_poses = seed.poses.shape[0]
+            deadline = seed.enqueued_at + self.max_delay_s
+            while True:
+                # sweep pending for the seed's key, preserving order of
+                # everything not absorbed; a candidate only joins if the
+                # whole group still fits the pose ceiling (an oversized
+                # SEED still dispatches alone — the engine chunks)
+                kept: deque[_Pending] = deque()
+                while self._pending:
+                    cand = self._pending.popleft()
+                    if (cand.key == seed.key
+                            and n_poses + cand.poses.shape[0]
+                            <= self.max_batch_poses):
+                        group.append(cand)
+                        n_poses += cand.poses.shape[0]
+                    else:
+                        kept.append(cand)
+                self._pending = kept
+                remaining = deadline - time.monotonic()
+                if (n_poses >= self.max_batch_poses or remaining <= 0
+                        or self._stop):
+                    break
+                self._cond.wait(timeout=remaining)
+            self._gauge_locked()
+            return group
+
+    def _run(self) -> None:
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            self._dispatch(group)
+
+    def _dispatch(self, group: list[_Pending]) -> None:
+        poses = np.concatenate([p.poses for p in group], axis=0)
+        if self._metrics is not None:
+            self._metrics.batch_dispatches.inc()
+            if len(group) >= 2:
+                self._metrics.batch_coalesced_dispatches.inc()
+        try:
+            rgb, disp = self._render_fn(group[0].entry, poses)
+        except BaseException as exc:  # noqa: BLE001 - forwarded to callers
+            for p in group:
+                p.future.set_exception(exc)
+            return
+        offset = 0
+        for p in group:
+            n = p.poses.shape[0]
+            p.future.set_result((rgb[offset:offset + n], disp[offset:offset + n]))
+            offset += n

@@ -1,0 +1,155 @@
+"""Byte-budgeted LRU cache of predicted MPIs.
+
+The serving asymmetry only pays off if the expensive half (one
+encoder-decoder pass per image) is amortized across many renders — which
+means MPIs must stay device-resident between requests. They are large: an
+S=32 MPI at 384x512 holds rgb (S,H,W,3) + sigma (S,H,W,1) fp32 ≈ 100 MB,
+three orders of magnitude bigger than a typical KV-cache entry. An
+entry-counted LRU would let a handful of high-resolution predicts silently
+exhaust HBM, so the budget — and the eviction accounting — is in BYTES.
+
+Keys are (image_digest, checkpoint_step, H, W, S): the same image predicted
+under a newer checkpoint, at a different resolution, or at a different
+plane count is a DIFFERENT MPI — omitting any of these would alias entries
+and silently serve frames at the wrong operating point. The digest is of
+the uploaded image bytes, computed by the caller (server.py) before any
+decode.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any
+
+# (image_digest, checkpoint_step, H, W, S) — S is the engine bucket's
+# coarse plane count (its spec identity; c2f renders at coarse + fine)
+CacheKey = tuple[str, int, int, int, int]
+
+
+def mpi_key(
+    image_digest: str, checkpoint_step: int, bucket: tuple[int, int, int]
+) -> CacheKey:
+    h, w, s = bucket
+    return (image_digest, int(checkpoint_step), int(h), int(w), int(s))
+
+
+def key_to_str(key: CacheKey) -> str:
+    """Wire encoding of a cache key (the `mpi_key` field in HTTP responses)."""
+    return ":".join(str(part) for part in key)
+
+
+def key_from_str(s: str) -> CacheKey:
+    digest, step, h, w, planes = s.rsplit(":", 4)
+    return (digest, int(step), int(h), int(w), int(planes))
+
+
+def _nbytes(arr: Any) -> int:
+    """Bytes of one array leaf (jax Array and np.ndarray both expose
+    size/dtype; jax's .nbytes can be missing on some array types)."""
+    return int(arr.size) * int(arr.dtype.itemsize)
+
+
+@dataclass
+class MPIEntry:
+    """One cached prediction: everything render-many needs, device-resident.
+
+    disparity is carried per-entry (not re-derived from config) because a
+    coarse-to-fine predict renders at its MERGED plane list — the cached
+    arrays and the disparity they were predicted at travel together
+    (inference/video.py predict_blended_mpi_c2f_fn).
+    """
+
+    mpi_rgb: Any  # (1, S, H, W, 3)
+    mpi_sigma: Any  # (1, S, H, W, 1)
+    disparity: Any  # (1, S)
+    k: Any  # (1, 3, 3) shared src/tgt intrinsics (single-image serving)
+    bucket: tuple[int, int, int]  # (H, W, S) engine shape bucket
+    nbytes: int = field(default=0)
+
+    def __post_init__(self) -> None:
+        if not self.nbytes:
+            self.nbytes = sum(
+                _nbytes(a)
+                for a in (self.mpi_rgb, self.mpi_sigma, self.disparity, self.k)
+            )
+
+
+class MPICache:
+    """Thread-safe LRU over MPIEntry values with byte-accounted eviction.
+
+    `get` refreshes recency; `put` evicts least-recently-used entries until
+    the resident total fits the budget. A single entry larger than the whole
+    budget is still admitted (after evicting everything else): refusing it
+    would make oversized requests uncacheable and re-run the encoder on
+    every render — strictly worse than a temporarily overshot budget. The
+    overshoot is visible in the bytes-resident gauge.
+    """
+
+    def __init__(self, byte_budget: int, metrics: Any | None = None):
+        if byte_budget <= 0:
+            raise ValueError(f"byte_budget must be positive, got {byte_budget}")
+        self.byte_budget = int(byte_budget)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[CacheKey, MPIEntry] = OrderedDict()
+        self._bytes = 0
+        self._metrics = metrics
+
+    @property
+    def bytes_resident(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> list[CacheKey]:
+        with self._lock:
+            return list(self._entries)
+
+    def get(self, key: CacheKey, record: bool = True) -> MPIEntry | None:
+        """Lookup + LRU touch. record=False skips the hit/miss counters —
+        for internal re-checks (the predict singleflight's under-lock peek)
+        that would otherwise double-count one logical request."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if record and self._metrics is not None:
+            if entry is not None:
+                self._metrics.cache_hits.inc()
+            else:
+                self._metrics.cache_misses.inc()
+        return entry
+
+    def put(self, key: CacheKey, entry: MPIEntry) -> list[CacheKey]:
+        """Insert (or refresh) an entry; returns the keys evicted for it."""
+        evicted: list[CacheKey] = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            # evict from the LRU end, never the entry just inserted
+            while self._bytes > self.byte_budget and len(self._entries) > 1:
+                victim_key, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                evicted.append(victim_key)
+            self._update_gauges_locked()
+        if self._metrics is not None and evicted:
+            self._metrics.cache_evictions.inc(len(evicted))
+        return evicted
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+            self._update_gauges_locked()
+
+    def _update_gauges_locked(self) -> None:
+        if self._metrics is not None:
+            self._metrics.cache_bytes_resident.set(self._bytes)
+            self._metrics.cache_entries.set(len(self._entries))

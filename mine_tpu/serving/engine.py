@@ -1,0 +1,336 @@
+"""RenderEngine: the long-lived, compile-bounded core of the serving stack.
+
+The one-shot inference path (mine_tpu/inference/video.py) jits per
+(config, pose-count) pair implicitly through jax.jit's trace cache — fine
+for a CLI that renders two trajectories and exits, but a server fed
+arbitrary request shapes would recompile unboundedly and stall live traffic
+for seconds per new shape. The engine makes the compile set explicit and
+finite:
+
+  * shape buckets (H, W, S): each bucket owns ONE AOT-compiled predict
+    executable and one render executable per padded pose count, built from
+    the pure functions the inference module exposes
+    (predict_blended_mpi_fn / render_many_fn) via jax.jit().lower().compile()
+    — so "did this request recompile?" is an inspectable counter, not a
+    guess about jit cache internals.
+  * pose-count buckets (powers of two): a render for N poses runs the
+    next-bucket executable on poses padded with identities and slices the
+    first N frames off the result. Unbounded distinct N collapses onto
+    log2(max_bucket) executables.
+  * donated request buffers: on accelerator backends the per-request inputs
+    (the prepared image for predict, the padded pose stack for render) are
+    donated, letting XLA reuse them as scratch instead of growing the
+    per-request HBM watermark. CPU ignores donation, so it is only
+    requested off-CPU (avoids jax's per-executable warning in tests).
+  * every executable is built behind utils/compile_cache.py's persistent
+    XLA cache, so a restarted server pre-warms from disk instead of
+    recompiling its whole bucket set.
+
+Coarse-to-fine configs compose: a bucket whose config carries
+mpi.num_bins_fine > 0 predicts through the two-pass c2f function and caches
+the MERGED plane list; its render executables are shaped for
+S_coarse + S_fine planes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from mine_tpu.config import Config
+from mine_tpu.serving.cache import MPIEntry
+from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
+
+BucketSpec = tuple[int, int, int]  # (H, W, S_coarse)
+
+_IDENTITY_POSE = np.eye(4, dtype=np.float32)
+
+
+def _abstract(tree: Any) -> Any:
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), tree
+    )
+
+
+class _Bucket:
+    """One (H, W, S) shape bucket: configs, constants, and executables."""
+
+    def __init__(self, engine: "RenderEngine", spec: BucketSpec):
+        import jax
+        import jax.numpy as jnp
+
+        from mine_tpu.inference.video import fov_intrinsics
+        from mine_tpu.training.step import make_disparity_list
+
+        h, w, s = spec
+        self.spec = spec
+        self.engine = engine
+        self.cfg = engine.base_cfg.replace(**{
+            "data.img_h": h, "data.img_w": w, "mpi.num_bins_coarse": s,
+        })
+        self.is_c2f = self.cfg.mpi.num_bins_fine > 0
+        self.num_planes = s + (self.cfg.mpi.num_bins_fine if self.is_c2f else 0)
+        # deterministic serving planes: the fix_disparity branch of the
+        # shared sampler (training/step.py make_disparity_list)
+        fixed = self.cfg.replace(**{"mpi.fix_disparity": True})
+        self.disparity = make_disparity_list(fixed, jax.random.PRNGKey(0), 1)
+        self.k = jnp.asarray(fov_intrinsics(h, w, engine.fov_deg))[None]
+        self._predict_exec = None
+        self._render_execs: dict[int, Any] = {}
+        self._lock = threading.Lock()
+
+    # -- executables ---------------------------------------------------------
+
+    # Both getters are double-checked: the lock-free fast path (atomic dict/
+    # attribute reads under the GIL) means an already-built executable is
+    # NEVER stalled behind another executable's multi-second compile on the
+    # same bucket — only genuine compiles serialize on the lock.
+
+    def predict_executable(self):
+        import jax
+
+        from mine_tpu.inference.video import (
+            predict_blended_mpi_c2f_fn,
+            predict_blended_mpi_fn,
+        )
+
+        exe = self._predict_exec
+        if exe is not None:
+            return exe
+        with self._lock:
+            if self._predict_exec is None:
+                h, w, _ = self.spec
+                donate = self.engine._donate((2,))
+                img = jax.ShapeDtypeStruct((1, h, w, 3), np.float32)
+                variables = _abstract(self.engine.variables)
+                if self.is_c2f:
+                    fn = jax.jit(
+                        predict_blended_mpi_c2f_fn, static_argnums=0, **donate
+                    )
+                    lowered = fn.lower(self.cfg, variables, img, self.k)
+                else:
+                    fn = jax.jit(
+                        predict_blended_mpi_fn, static_argnums=0, **donate
+                    )
+                    lowered = fn.lower(
+                        self.cfg, variables, img, self.disparity, self.k
+                    )
+                self._predict_exec = lowered.compile()
+                self.engine._count_compile("predict")
+            return self._predict_exec
+
+    def render_executable(self, n_poses: int):
+        import jax
+
+        from mine_tpu.inference.video import render_many_fn
+
+        exe = self._render_execs.get(n_poses)
+        if exe is not None:
+            return exe
+        with self._lock:
+            exe = self._render_execs.get(n_poses)
+            if exe is None:
+                h, w, _ = self.spec
+                s = self.num_planes
+                donate = self.engine._donate((5,))
+                fn = jax.jit(render_many_fn, static_argnums=0, **donate)
+                lowered = fn.lower(
+                    self.cfg,
+                    jax.ShapeDtypeStruct((1, s, h, w, 3), np.float32),
+                    jax.ShapeDtypeStruct((1, s, h, w, 1), np.float32),
+                    jax.ShapeDtypeStruct((1, s), np.float32),
+                    jax.ShapeDtypeStruct((1, 3, 3), np.float32),
+                    jax.ShapeDtypeStruct((n_poses, 4, 4), np.float32),
+                )
+                exe = lowered.compile()
+                self._render_execs[n_poses] = exe
+                self.engine._count_compile("render")
+            return exe
+
+
+class RenderEngine:
+    """Predict-once / render-many over a fixed checkpoint's weights.
+
+    Thread-safe: predict and render may be called concurrently from HTTP
+    handler threads and the batcher worker; compiles are serialized per
+    bucket, device dispatches go through jax's own locking.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        batch_stats: Any,
+        checkpoint_step: int = 0,
+        metrics: Any | None = None,
+        pose_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64),
+        fov_deg: float = 90.0,
+    ):
+        import jax
+
+        enable_persistent_compile_cache()
+        self.base_cfg = cfg
+        # device_put ONCE: a checkpoint restored template-free
+        # (training/checkpoint.py load_for_serving) arrives as host numpy
+        # leaves, and numpy inputs to a compiled executable re-transfer on
+        # every call — the whole params tree per predict, the exact cost a
+        # long-lived engine exists to amortize away
+        self.variables = jax.device_put(
+            {"params": params, "batch_stats": batch_stats}
+        )
+        self.checkpoint_step = int(checkpoint_step)
+        self.metrics = metrics
+        self.pose_buckets = tuple(sorted(set(int(n) for n in pose_buckets)))
+        if not self.pose_buckets or self.pose_buckets[0] < 1:
+            raise ValueError(f"bad pose_buckets {pose_buckets}")
+        self.fov_deg = fov_deg
+        self.default_bucket: BucketSpec = (
+            cfg.data.img_h, cfg.data.img_w, cfg.mpi.num_bins_coarse
+        )
+        self.compiles = 0  # total executables built (also in metrics)
+        self._buckets: dict[BucketSpec, _Bucket] = {}
+        self._buckets_lock = threading.Lock()
+
+    # -- internals -----------------------------------------------------------
+
+    def _donate(self, argnums: tuple[int, ...]) -> dict:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            return {}  # CPU ignores donation and warns per executable
+        return {"donate_argnums": argnums}
+
+    def _count_compile(self, kind: str) -> None:
+        self.compiles += 1
+        if self.metrics is not None:
+            self.metrics.engine_compiles.inc(kind=kind)
+
+    def bucket(self, spec: BucketSpec | None = None) -> _Bucket:
+        spec = self.default_bucket if spec is None else tuple(map(int, spec))
+        h, w, s = spec
+        if h % 128 or w % 128:
+            # same constraint the model enforces (training/step.py
+            # build_model) — fail at request validation, not inside a trace
+            raise ValueError(
+                f"bucket H={h}, W={w} must be multiples of 128 "
+                "(MPI decoder receptive-field extension)"
+            )
+        if s < 2:
+            raise ValueError(f"bucket S={s} must be >= 2")
+        with self._buckets_lock:
+            b = self._buckets.get(spec)
+            if b is None:
+                b = _Bucket(self, spec)
+                self._buckets[spec] = b
+            return b
+
+    def bucket_specs(self) -> list[BucketSpec]:
+        with self._buckets_lock:
+            return list(self._buckets)
+
+    def _pose_bucket(self, n: int) -> int:
+        for b in self.pose_buckets:
+            if n <= b:
+                return b
+        return self.pose_buckets[-1]
+
+    # -- the two halves ------------------------------------------------------
+
+    def predict(
+        self, image: np.ndarray, spec: BucketSpec | None = None
+    ) -> MPIEntry:
+        """Run the encoder-decoder once; returns a device-resident MPIEntry.
+
+        image: (h, w, 3) uint8 or float in [0, 1] at any resolution — it is
+        resized to the bucket's (H, W) exactly like the one-shot CLI
+        (inference/video.py prepare_image).
+        """
+        from mine_tpu.inference.video import prepare_image
+
+        bucket = self.bucket(spec)
+        h, w, _ = bucket.spec
+        img = prepare_image(image, h, w)
+        exe = bucket.predict_executable()
+        if bucket.is_c2f:
+            mpi_rgb, mpi_sigma, disparity = exe(self.variables, img, bucket.k)
+        else:
+            mpi_rgb, mpi_sigma = exe(
+                self.variables, img, bucket.disparity, bucket.k
+            )
+            disparity = bucket.disparity
+        if self.metrics is not None:
+            self.metrics.encoder_invocations.inc()
+        return MPIEntry(
+            mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma, disparity=disparity,
+            k=bucket.k, bucket=bucket.spec,
+        )
+
+    def render(
+        self, entry: MPIEntry, poses: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Render (N, 4, 4) G_tgt_src poses against a cached MPI.
+
+        Pads N up to the next pose bucket (identity poses, discarded) and
+        runs that bucket's executable; N beyond the largest bucket chunks
+        into largest-bucket dispatches. Returns host arrays
+        (rgb (N, H, W, 3) float [0, 1], disparity (N, H, W, 1)).
+        """
+        import jax
+
+        poses = np.asarray(poses, np.float32)
+        if poses.ndim != 3 or poses.shape[1:] != (4, 4):
+            raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")
+        n = poses.shape[0]
+        if n == 0:
+            h, w, _ = entry.bucket
+            return (np.zeros((0, h, w, 3), np.float32),
+                    np.zeros((0, h, w, 1), np.float32))
+        bucket = self.bucket(entry.bucket)
+        max_b = self.pose_buckets[-1]
+        rgb_parts, disp_parts = [], []
+        for start in range(0, n, max_b):
+            chunk = poses[start:start + max_b]
+            nb = self._pose_bucket(chunk.shape[0])
+            if chunk.shape[0] < nb:
+                pad = np.broadcast_to(
+                    _IDENTITY_POSE, (nb - chunk.shape[0], 4, 4)
+                )
+                padded = np.concatenate([chunk, pad], axis=0)
+            else:
+                padded = chunk
+            exe = bucket.render_executable(nb)
+            rgb, disp = exe(
+                entry.mpi_rgb, entry.mpi_sigma, entry.disparity, entry.k,
+                jax.numpy.asarray(padded),
+            )
+            rgb_parts.append(np.asarray(jax.device_get(rgb))[:chunk.shape[0]])
+            disp_parts.append(np.asarray(jax.device_get(disp))[:chunk.shape[0]])
+        if self.metrics is not None:
+            self.metrics.rendered_frames.inc(n)
+            self.metrics.renders_per_sec.record(n)
+        if len(rgb_parts) == 1:
+            return rgb_parts[0], disp_parts[0]
+        return np.concatenate(rgb_parts), np.concatenate(disp_parts)
+
+    # -- pre-warming ---------------------------------------------------------
+
+    def warmup(
+        self,
+        specs: list[BucketSpec] | None = None,
+        pose_counts: tuple[int, ...] | None = None,
+    ) -> int:
+        """Compile the expected executable set before taking traffic
+        (persisted by the XLA compile cache across restarts). Returns the
+        number of executables built by this call."""
+        before = self.compiles
+        for spec in (specs if specs is not None else [self.default_bucket]):
+            bucket = self.bucket(spec)
+            bucket.predict_executable()
+            for nb in (pose_counts if pose_counts is not None
+                       else self.pose_buckets):
+                bucket.render_executable(self._pose_bucket(nb))
+        return self.compiles - before

@@ -1,0 +1,129 @@
+"""The serving metric set, on mine_tpu.utils.metrics' registry.
+
+One place defines every metric name the /metrics endpoint exports, so the
+README table, the tests, and tools/bench_serve.py all reference the same
+spelling. Prefix: `mine_serve_`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from mine_tpu.utils.metrics import MetricsRegistry
+
+
+class RateGauge:
+    """Rolling throughput gauge: record(n) events, value() = n/sec over the
+    trailing window. Backed by a plain gauge family in the registry that is
+    refreshed on every record AND on every scrape (server.py calls
+    refresh() before rendering), so an idle server decays to 0 instead of
+    freezing at its last burst."""
+
+    def __init__(self, gauge, window_s: float = 30.0):
+        self._gauge = gauge
+        self._window_s = window_s
+        self._events: deque[tuple[float, float]] = deque()
+        self._lock = threading.Lock()
+
+    def record(self, n: float, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._events.append((now, float(n)))
+            self._gauge.set(self._rate_locked(now))
+
+    def refresh(self, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            rate = self._rate_locked(now)
+            self._gauge.set(rate)
+            return rate
+
+    def _rate_locked(self, now: float) -> float:
+        cutoff = now - self._window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+        if not self._events:
+            return 0.0
+        total = sum(n for _, n in self._events)
+        # span from the oldest retained event, floored to avoid a huge rate
+        # from a single instantaneous burst
+        span = max(now - self._events[0][0], 1.0)
+        return total / span
+
+
+class ServingMetrics:
+    """Every serving metric, created against one registry."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+
+        # HTTP surface
+        self.requests = r.counter(
+            "mine_serve_requests_total",
+            "HTTP requests by endpoint and status code",
+        )
+        self.request_latency = r.summary(
+            "mine_serve_request_latency_seconds",
+            "request wall time by endpoint (windowed p50/p95)",
+        )
+
+        # engine
+        self.encoder_invocations = r.counter(
+            "mine_serve_encoder_invocations_total",
+            "full encoder-decoder predict passes actually executed "
+            "(cache hits do not count — this is the expensive half)",
+        )
+        self.engine_compiles = r.counter(
+            "mine_serve_engine_compiles_total",
+            "XLA executables compiled, by kind (predict/render); bounded by "
+            "the shape-bucket and pose-bucket sets",
+        )
+        self.rendered_frames = r.counter(
+            "mine_serve_rendered_frames_total",
+            "novel-view frames rendered (padding frames excluded)",
+        )
+        self.renders_per_sec = RateGauge(r.gauge(
+            "mine_serve_renders_per_sec",
+            "rendered frames per second over the trailing window",
+        ))
+
+        # MPI cache
+        self.cache_hits = r.counter(
+            "mine_serve_cache_hits_total", "MPI cache hits")
+        self.cache_misses = r.counter(
+            "mine_serve_cache_misses_total", "MPI cache misses")
+        self.cache_evictions = r.counter(
+            "mine_serve_cache_evictions_total",
+            "MPI cache entries evicted for the byte budget",
+        )
+        self.cache_bytes_resident = r.gauge(
+            "mine_serve_cache_bytes_resident",
+            "bytes of MPI data currently cached",
+        )
+        self.cache_entries = r.gauge(
+            "mine_serve_cache_entries", "MPI cache entry count")
+
+        # micro-batcher
+        self.batch_dispatches = r.counter(
+            "mine_serve_batch_dispatches_total",
+            "render-many dispatches issued by the micro-batcher",
+        )
+        self.batch_requests = r.counter(
+            "mine_serve_batch_requests_total",
+            "render requests that entered the micro-batcher",
+        )
+        self.batch_coalesced_dispatches = r.counter(
+            "mine_serve_batch_coalesced_dispatches_total",
+            "dispatches that coalesced >= 2 requests into one render-many",
+        )
+        self.batch_queue_depth = r.gauge(
+            "mine_serve_batch_queue_depth",
+            "render requests waiting in the micro-batcher",
+        )
+
+    def render(self) -> str:
+        self.renders_per_sec.refresh()
+        return self.registry.render()

@@ -1,0 +1,444 @@
+"""HTTP serving surface: /predict, /render, /healthz, /metrics.
+
+Stdlib only (http.server.ThreadingHTTPServer — this image has no web
+framework and the hard constraint is no new dependencies). Handler threads
+do the cheap work (decode, digest, cache lookup) and block on futures for
+the expensive work, which single-file through the engine/batcher; the
+threading model mirrors the reference's asymmetry: many waiters, one
+device.
+
+Endpoints:
+  POST /predict   image bytes (PNG/JPEG, raw body or JSON {"image_b64"})
+                  -> {"mpi_key", "cached", "bucket", "planes", "mpi_bytes"}.
+                  Runs the encoder-decoder ONCE per distinct
+                  (image bytes, checkpoint step, plane count); repeats are
+                  cache hits that never touch the network.
+  POST /render    JSON {"mpi_key", "poses" (N,4,4) | "offsets" (N,3)}
+                  -> {"frames_png_b64": [...], ...}. 404 when the MPI fell
+                  out of the cache (client re-predicts). Concurrent renders
+                  of one MPI coalesce into one dispatch (batcher.py).
+  GET  /healthz   liveness + engine/bucket/cache snapshot.
+  GET  /metrics   Prometheus text exposition (serving/metrics.py names).
+
+CLI: python -m mine_tpu.serving.server --workspace <train workspace>
+restores params only (training/checkpoint.py load_for_serving), pre-warms
+the default bucket's executables, and serves until killed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import hashlib
+import io
+import json
+import threading
+import time
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from mine_tpu.config import Config
+from mine_tpu.serving.batcher import MicroBatcher
+from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
+from mine_tpu.serving.engine import BucketSpec, RenderEngine
+from mine_tpu.serving.metrics import ServingMetrics
+
+
+def _decode_image(data: bytes) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+def _encode_png(frame_u8: np.ndarray) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(frame_u8).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _poses_from_body(body: dict) -> np.ndarray:
+    """(N, 4, 4) pose stack from a /render body: full poses, or camera-center
+    offsets the single-image app's trajectory module turns into identity-
+    rotation poses (inference/trajectory.py poses_from_offsets)."""
+    if "poses" in body:
+        poses = np.asarray(body["poses"], np.float32)
+        if poses.ndim == 2 and poses.shape[1] == 16:
+            poses = poses.reshape(-1, 4, 4)
+        if poses.ndim != 3 or poses.shape[1:] != (4, 4):
+            raise ValueError(
+                f"poses must be (N, 4, 4) (or N x 16 flat), got {poses.shape}"
+            )
+        return poses
+    if "offsets" in body:
+        from mine_tpu.inference.trajectory import poses_from_offsets
+
+        offsets = np.asarray(body["offsets"], np.float64)
+        if offsets.ndim != 2 or offsets.shape[1] != 3:
+            raise ValueError(f"offsets must be (N, 3), got {offsets.shape}")
+        return poses_from_offsets(offsets)
+    raise ValueError('render body needs "poses" or "offsets"')
+
+
+class ServingApp:
+    """Engine + cache + batcher + metrics assembled for one checkpoint."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        batch_stats: Any,
+        checkpoint_step: int = 0,
+        cache_bytes: int = 2 << 30,
+        max_delay_ms: float = 4.0,
+        max_batch_poses: int = 64,
+        fov_deg: float = 90.0,
+        request_timeout_s: float = 300.0,
+        metrics: ServingMetrics | None = None,
+        allowed_buckets: list[BucketSpec] | None = None,
+    ):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.engine = RenderEngine(
+            cfg, params, batch_stats, checkpoint_step=checkpoint_step,
+            metrics=self.metrics, fov_deg=fov_deg,
+        )
+        # shapes an untrusted /predict body may request: each admitted spec
+        # costs a full XLA compile + an O(S*H*W) resident MPI, so the set is
+        # operator-configured, never client-grown (the compile-boundedness
+        # the engine's bucket design exists for)
+        self.allowed_buckets: set[BucketSpec] = {self.engine.default_bucket}
+        for spec in allowed_buckets or ():
+            self.allowed_buckets.add(tuple(int(v) for v in spec))
+        self.cache = MPICache(cache_bytes, metrics=self.metrics)
+        self.batcher = MicroBatcher(
+            self.engine.render, max_delay_ms=max_delay_ms,
+            max_batch_poses=max_batch_poses, metrics=self.metrics,
+        ).start()
+        self.request_timeout_s = request_timeout_s
+        self._started_at = time.time()
+        # predict singleflight: concurrent misses for one key share one
+        # encoder pass (the batcher's coalescing idea applied to the
+        # expensive half — without it, N simultaneous uploads of one image
+        # run N encoder passes and materialize N ~100 MB MPIs)
+        self._inflight: dict[Any, Future] = {}
+        self._inflight_lock = threading.Lock()
+
+    def predict(self, image_bytes: bytes, spec: BucketSpec | None = None) -> dict:
+        digest = hashlib.sha256(image_bytes).hexdigest()
+        if spec is not None:
+            spec = tuple(int(v) for v in spec)
+            if spec not in self.allowed_buckets:
+                raise ValueError(
+                    f"bucket {list(spec)} is not served; allowed: "
+                    f"{sorted(list(b) for b in self.allowed_buckets)} "
+                    "(extend with --bucket H,W,S at server start)"
+                )
+        bucket = self.engine.bucket(spec)  # validates the requested shape
+        key = mpi_key(digest, self.engine.checkpoint_step, bucket.spec)
+
+        def response(entry, cached: bool) -> dict:
+            return {
+                "mpi_key": key_to_str(key),
+                "cached": cached,
+                "bucket": list(bucket.spec),
+                "planes": bucket.num_planes,
+                "mpi_bytes": entry.nbytes,
+            }
+
+        entry = self.cache.get(key)
+        if entry is not None:
+            return response(entry, cached=True)
+        with self._inflight_lock:
+            future = self._inflight.get(key)
+            owner = future is None
+            if owner:
+                # re-check under the lock: the owner publishes to the cache
+                # BEFORE dropping its inflight marker, so "no marker" can
+                # mean "just finished" (counted above already, record=False)
+                entry = self.cache.get(key, record=False)
+                if entry is not None:
+                    return response(entry, cached=True)
+                future = Future()
+                self._inflight[key] = future
+        if not owner:
+            # follower: share the owner's encoder pass (its exception too)
+            return response(
+                future.result(timeout=self.request_timeout_s), cached=True
+            )
+        try:
+            entry = self.engine.predict(_decode_image(image_bytes), bucket.spec)
+            self.cache.put(key, entry)
+            future.set_result(entry)
+        except BaseException as exc:
+            future.set_exception(exc)
+            raise
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(key, None)
+        return response(entry, cached=False)
+
+    def render(self, key_str: str, poses: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        key = key_from_str(key_str)
+        entry = self.cache.get(key)
+        if entry is None:
+            raise KeyError(key_str)
+        future = self.batcher.submit(key, entry, poses)
+        return future.result(timeout=self.request_timeout_s)
+
+    def health(self) -> dict:
+        import jax
+
+        return {
+            "status": "ok",
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "backend": jax.default_backend(),
+            "checkpoint_step": self.engine.checkpoint_step,
+            "buckets": [list(s) for s in self.engine.bucket_specs()],
+            "compiles": self.engine.compiles,
+            "cache_entries": len(self.cache),
+            "cache_bytes_resident": self.cache.bytes_resident,
+            "queue_depth": self.batcher.queue_depth(),
+        }
+
+    def close(self) -> None:
+        self.batcher.stop()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one ThreadingHTTPServer thread per in-flight request; the shared app
+    # object is thread-safe by construction (cache/batcher/engine locks)
+    server: "ServingHTTPServer"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------------
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, payload: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json")
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _route(self, method: str, path: str) -> tuple[int, str]:
+        app = self.server.app
+        if method == "GET" and path == "/healthz":
+            self._send_json(200, app.health())
+            return 200, "healthz"
+        if method == "GET" and path == "/metrics":
+            self._send(200, app.metrics.render().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return 200, "metrics"
+        if method == "POST" and path == "/predict":
+            return self._predict(app), "predict"
+        if method == "POST" and path == "/render":
+            return self._render(app), "render"
+        self._send_json(404, {"error": f"no route {method} {path}"})
+        return 404, "unknown"
+
+    def _handle(self, method: str) -> None:
+        app = self.server.app
+        path = self.path.split("?", 1)[0]
+        t0 = time.monotonic()
+        try:
+            code, endpoint = self._route(method, path)
+        except (BrokenPipeError, ConnectionResetError):
+            raise
+        except Exception as exc:  # noqa: BLE001 - HTTP boundary
+            code, endpoint = 500, path.lstrip("/") or "unknown"
+            try:
+                self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            except Exception:  # noqa: BLE001 - client already gone
+                pass
+        app.metrics.requests.inc(endpoint=endpoint, status=str(code))
+        app.metrics.request_latency.observe(
+            time.monotonic() - t0, endpoint=endpoint
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        self._handle("GET")
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        self._handle("POST")
+
+    # -- endpoints -----------------------------------------------------------
+
+    def _predict(self, app: ServingApp) -> int:
+        body = self._read_body()
+        spec = None
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == "application/json":
+            try:
+                req = json.loads(body)
+                image_bytes = base64.b64decode(req["image_b64"])
+                if req.get("bucket") is not None:
+                    spec = tuple(int(v) for v in req["bucket"])
+            except (KeyError, ValueError, TypeError) as exc:
+                self._send_json(400, {"error": f"bad predict body: {exc}"})
+                return 400
+        else:
+            image_bytes = body  # raw PNG/JPEG bytes
+        if not image_bytes:
+            self._send_json(400, {"error": "empty image"})
+            return 400
+        try:
+            result = app.predict(image_bytes, spec)
+        except (ValueError, OSError) as exc:
+            # bad bucket (ValueError) or undecodable/truncated image bytes —
+            # PIL's UnidentifiedImageError subclasses OSError, not ValueError
+            self._send_json(400, {"error": str(exc)})
+            return 400
+        self._send_json(200, result)
+        return 200
+
+    def _render(self, app: ServingApp) -> int:
+        try:
+            req = json.loads(self._read_body())
+            key_str = req["mpi_key"]
+            key_from_str(key_str)  # malformed keys are a 400, not a 500
+            poses = _poses_from_body(req)
+        except (KeyError, ValueError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad render body: {exc}"})
+            return 400
+        try:
+            rgb, disp = app.render(key_str, poses)
+        except KeyError:
+            self._send_json(404, {
+                "error": f"mpi_key {key_str} not cached (evicted or never "
+                "predicted) — POST /predict again",
+            })
+            return 404
+        from mine_tpu.inference.video import normalize_disparity, to_uint8
+
+        frames = [
+            base64.b64encode(_encode_png(f)).decode()
+            for f in to_uint8(np.clip(rgb, 0.0, 1.0))
+        ]
+        out: dict[str, Any] = {
+            "mpi_key": key_str,
+            "num_frames": int(rgb.shape[0]),
+            "height": int(rgb.shape[1]),
+            "width": int(rgb.shape[2]),
+            "frames_png_b64": frames,
+        }
+        if req.get("include_disparity"):
+            out["disparity_png_b64"] = [
+                base64.b64encode(_encode_png(f)).decode()
+                for f in to_uint8(normalize_disparity(disp))[..., 0]
+            ]
+        self._send_json(200, out)
+        return 200
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # long-lived localhost sockets; rebinding a just-closed test port is fine
+    allow_reuse_address = True
+
+    def __init__(self, addr: tuple[str, int], app: ServingApp,
+                 verbose: bool = False):
+        super().__init__(addr, _Handler)
+        self.app = app
+        self.verbose = verbose
+
+
+def make_server(
+    app: ServingApp, host: str = "127.0.0.1", port: int = 0,
+    verbose: bool = False,
+) -> ServingHTTPServer:
+    """Bind (port=0 -> ephemeral, server.server_address reports it); the
+    caller drives serve_forever(), usually on a thread."""
+    return ServingHTTPServer((host, port), app, verbose=verbose)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--workspace", required=True,
+        help="training workspace dir (params.yaml + checkpoints/)",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument(
+        "--cache-mb", type=int, default=2048,
+        help="MPI cache byte budget in MiB",
+    )
+    parser.add_argument("--max-delay-ms", type=float, default=4.0,
+                        help="micro-batching max coalescing delay")
+    parser.add_argument("--max-batch-poses", type=int, default=64)
+    parser.add_argument(
+        "--bucket", action="append", default=[], metavar="H,W,S",
+        help="additional (H, W, S) shape bucket clients may request via "
+        "/predict's \"bucket\" field (repeatable; the config's own shape "
+        "is always served). Each bucket costs one-time XLA compiles and "
+        "O(S*H*W) cache bytes per entry — hence operator-allowlisted.",
+    )
+    parser.add_argument("--fov", type=float, default=90.0)
+    parser.add_argument(
+        "--extra_config", default=None,
+        help="JSON dot-key overrides layered over the archived params.yaml",
+    )
+    parser.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip pre-compiling the default bucket before binding",
+    )
+    parser.add_argument(
+        "--allow-random-init", action="store_true",
+        help="serve untrained weights when no checkpoint exists (smoke only)",
+    )
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    from mine_tpu.training.checkpoint import load_for_serving
+
+    cfg, params, batch_stats, step = load_for_serving(
+        args.workspace, overrides=args.extra_config,
+        allow_random_init=args.allow_random_init,
+    )
+    extra_buckets = [
+        tuple(int(v) for v in spec.split(",")) for spec in args.bucket
+    ]
+    app = ServingApp(
+        cfg, params, batch_stats, checkpoint_step=step,
+        cache_bytes=args.cache_mb << 20, max_delay_ms=args.max_delay_ms,
+        max_batch_poses=args.max_batch_poses, fov_deg=args.fov,
+        allowed_buckets=extra_buckets,
+    )
+    if not args.no_warmup:
+        built = app.engine.warmup(specs=sorted(app.allowed_buckets))
+        print(f"warmup: {built} executables compiled "
+              f"(buckets {sorted(app.allowed_buckets)})")
+    server = make_server(app, args.host, args.port, verbose=args.verbose)
+    host, port = server.server_address[:2]
+    print(f"serving checkpoint step {step} on http://{host}:{port} "
+          f"(/predict /render /healthz /metrics)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        app.close()
+
+
+if __name__ == "__main__":
+    main()

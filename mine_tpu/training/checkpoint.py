@@ -126,3 +126,52 @@ def load_paired_config(workspace: str, overrides: str | None = None) -> Config:
 
 def wait_until_finished(manager: ocp.CheckpointManager) -> None:
     manager.wait_until_finished()
+
+
+def load_for_serving(
+    workspace: str,
+    overrides: str | None = None,
+    allow_random_init: bool = False,
+) -> tuple[Config, Any, Any, int]:
+    """Restore (cfg, params, batch_stats, step) for inference/serving.
+
+    Unlike the training resume path (restore() against an init_state
+    template), this never materializes optimizer state: the checkpoint is
+    read template-free and only the params/batch_stats subtrees are kept —
+    for a serving process the Adam moments would be pure dead weight (2x
+    params bytes) competing with the MPI cache for device memory.
+
+    Returns step = the checkpoint step served (0 with allow_random_init and
+    no checkpoint — smoke runs only; the step is part of every MPI cache
+    key, so serving a random init never aliases a trained model's cache).
+    """
+    cfg = load_paired_config(workspace, overrides)
+    manager = checkpoint_manager(workspace)
+    step = manager.latest_step()
+    if step is None:
+        if not allow_random_init:
+            raise FileNotFoundError(
+                f"no checkpoint found under {workspace}/checkpoints "
+                "(pass allow_random_init=True for an untrained smoke run)"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        from mine_tpu.training.step import build_model
+
+        model = build_model(cfg)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, cfg.data.img_h, cfg.data.img_w, 3), jnp.float32),
+            jnp.linspace(
+                cfg.mpi.disparity_start, cfg.mpi.disparity_end,
+                cfg.mpi.num_bins_coarse,
+            )[None, :],
+            True,
+        )
+        return cfg, variables["params"], variables.get("batch_stats", {}), 0
+    # template-free restore: a raw pytree of host arrays (the explicit
+    # StandardRestore arg matters — a fresh manager has no handler registered
+    # for the saved item and a bare restore(step) raises)
+    raw = manager.restore(step, args=ocp.args.StandardRestore())
+    return cfg, raw["params"], raw["batch_stats"], int(step)

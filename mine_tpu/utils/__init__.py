@@ -7,3 +7,9 @@ from mine_tpu.utils.logging import (
     make_logger,
     normalize_disparity_for_vis,
 )
+from mine_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    Summary,
+)

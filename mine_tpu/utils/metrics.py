@@ -1,0 +1,199 @@
+"""Process-local metrics registry with Prometheus text exposition.
+
+No reference analog and no new dependency: the serving subsystem
+(mine_tpu/serving/) must report what it is doing — request counts, cache
+hit/miss, bytes resident, queue depth, latency quantiles — over a plain
+HTTP `/metrics` endpoint, and this image has no `prometheus_client`. The
+registry implements the minimal subset of the Prometheus data model the
+serving metrics need (counters, gauges, label sets, and a windowed summary
+for latency quantiles) and renders text exposition format 0.0.4.
+
+Thread-safety: every mutation takes the registry lock — the serving stack
+updates metrics from HTTP handler threads and the batcher worker thread
+concurrently. The lock is registry-wide (not per-family): contention is
+irrelevant at serving rates and one lock keeps `render()` a consistent
+snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+def _format_labels(labels: tuple[tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _format_value(v: float) -> str:
+    # Prometheus wants plain decimals; ints render without the trailing .0
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Family:
+    """One named metric family: help text, type, and labeled children."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help_text: str,
+                 kind: str):
+        self.registry = registry
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self._children: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def _key(self, labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for labels in sorted(self._children):
+            lines.append(
+                f"{self.name}{_format_labels(labels)} "
+                f"{_format_value(self._children[labels])}"
+            )
+        return lines
+
+
+class Counter(_Family):
+    """Monotonically increasing counter (optionally labeled)."""
+
+    def __init__(self, registry, name, help_text):
+        super().__init__(registry, name, help_text, "counter")
+
+    def inc(self, n: float = 1.0, **labels: str) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (n={n})")
+        key = self._key(labels)
+        with self.registry._lock:
+            self._children[key] = self._children.get(key, 0.0) + n
+
+    def value(self, **labels: str) -> float:
+        with self.registry._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+
+class Gauge(_Family):
+    """Settable point-in-time value (optionally labeled)."""
+
+    def __init__(self, registry, name, help_text):
+        super().__init__(registry, name, help_text, "gauge")
+
+    def set(self, v: float, **labels: str) -> None:
+        with self.registry._lock:
+            self._children[self._key(labels)] = float(v)
+
+    def inc(self, n: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self.registry._lock:
+            self._children[key] = self._children.get(key, 0.0) + n
+
+    def dec(self, n: float = 1.0, **labels: str) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self.registry._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+
+class Summary(_Family):
+    """Windowed summary: running count/sum plus quantiles over the last
+    `window` observations (a true streaming quantile sketch is overkill for
+    a serving sidecar; a bounded window gives honest recent p50/p95)."""
+
+    def __init__(self, registry, name, help_text, window: int = 1024,
+                 quantiles: tuple[float, ...] = (0.5, 0.95)):
+        super().__init__(registry, name, help_text, "summary")
+        self.window = window
+        self.quantiles = quantiles
+        self._obs: dict[tuple[tuple[str, str], ...], deque] = {}
+        self._count: dict[tuple[tuple[str, str], ...], float] = {}
+        self._sum: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def observe(self, v: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self.registry._lock:
+            dq = self._obs.setdefault(key, deque(maxlen=self.window))
+            dq.append(float(v))
+            self._count[key] = self._count.get(key, 0.0) + 1
+            self._sum[key] = self._sum.get(key, 0.0) + float(v)
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Nearest-rank quantile over the current window (nan when empty)."""
+        key = self._key(labels)
+        with self.registry._lock:
+            dq = self._obs.get(key)
+            if not dq:
+                return float("nan")
+            ordered = sorted(dq)
+        idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+        return ordered[idx]
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} summary"]
+        for key in sorted(self._obs):
+            ordered = sorted(self._obs[key])
+            for q in self.quantiles:
+                idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+                qlabels = key + (("quantile", repr(float(q))),)
+                lines.append(
+                    f"{self.name}{_format_labels(tuple(sorted(qlabels)))} "
+                    f"{_format_value(ordered[idx])}"
+                )
+            lines.append(
+                f"{self.name}_sum{_format_labels(key)} "
+                f"{_format_value(self._sum[key])}"
+            )
+            lines.append(
+                f"{self.name}_count{_format_labels(key)} "
+                f"{_format_value(self._count[key])}"
+            )
+        return lines
+
+
+class MetricsRegistry:
+    """Families by name; renders the whole set as one text page."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+
+    def _register(self, family: _Family) -> _Family:
+        with self._lock:
+            existing = self._families.get(family.name)
+            if existing is not None:
+                if type(existing) is not type(family):
+                    raise ValueError(
+                        f"metric {family.name} already registered as "
+                        f"{existing.kind}"
+                    )
+                return existing
+            self._families[family.name] = family
+            return family
+
+    def counter(self, name: str, help_text: str) -> Counter:
+        return self._register(Counter(self, name, help_text))
+
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        return self._register(Gauge(self, name, help_text))
+
+    def summary(self, name: str, help_text: str, window: int = 1024,
+                quantiles: tuple[float, ...] = (0.5, 0.95)) -> Summary:
+        return self._register(
+            Summary(self, name, help_text, window=window, quantiles=quantiles)
+        )
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4, trailing newline."""
+        with self._lock:
+            families = list(self._families.values())
+            lines: list[str] = []
+            for fam in families:
+                lines.extend(fam.collect())
+        return "\n".join(lines) + "\n"

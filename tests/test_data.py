@@ -240,8 +240,48 @@ def test_llff_behind_camera_points_culled(tmp_path):
         assert len(im.pts_cam) == n_world - 1  # the behind point is culled
         assert np.all(im.pts_cam[:, 2] > 0)
 
-    with pytest.raises(ValueError, match="culled for non-positive depth"):
+    with pytest.raises(ValueError, match="culled below the scene min depth"):
         load_scene(scene_dir, "images", (64, 64), 1.0, min_points=n_world)
+
+
+def test_llff_near_plane_outlier_culled(tmp_path):
+    """A track point a hair in FRONT of the lens (z = 1e-4 when the scene's
+    median depth is ~4) must be culled like a behind-camera point: its
+    1/z ~ 1e4 disparity would dominate exp(mean(log)) scale calibration and
+    the log-disparity loss for the whole image (ADVICE r5). Genuine
+    foreground at a meaningful fraction of the median depth survives."""
+    from mine_tpu.data import colmap
+    from mine_tpu.data.llff import MIN_DEPTH_FRACTION, load_scene
+
+    _make_colmap_scene(str(tmp_path), "scene_a", n_views=3)
+    scene_dir = os.path.join(tmp_path, "scene_a")
+    (cameras, images, pts), sparse = _scene_model(scene_dir)
+    grazing_id = max(pts) + 1
+    pts[grazing_id] = colmap.Point3D(  # lens-grazing reconstruction artifact
+        grazing_id, np.array([0.0, 0.0, 1e-4]),
+        np.array([0, 255, 0], np.uint8), 0.5,
+    )
+    near_id = grazing_id + 1
+    pts[near_id] = colmap.Point3D(  # genuine near geometry (NEAR_DEPTH=1)
+        near_id, np.array([0.1, 0.1, 1.0]), np.array([0, 0, 255], np.uint8), 0.5,
+    )
+    for iid, m in list(images.items()):
+        images[iid] = colmap.ImageMeta(
+            m.id, m.qvec, m.tvec, m.camera_id, m.name,
+            np.concatenate([m.xys, [[1.0, 1.0], [2.0, 2.0]]]),
+            np.concatenate([m.point3d_ids, [grazing_id, near_id]]),
+        )
+    colmap.write_points3d_binary(pts, os.path.join(sparse, "points3D.bin"))
+    colmap.write_images_binary(images, os.path.join(sparse, "images.bin"))
+
+    n_world = len(pts)
+    loaded = load_scene(scene_dir, "images", (64, 64), 1.0)
+    for im in loaded:
+        assert len(im.pts_cam) == n_world - 1  # only the grazing point culled
+        median = np.median(im.pts_cam[:, 2])
+        assert np.all(im.pts_cam[:, 2] > MIN_DEPTH_FRACTION * median * 0.99)
+        # the genuine near point survived
+        assert np.any(np.abs(im.pts_cam[:, 2] - 1.0) < 0.5)
 
 
 def test_llff_incompatible_camera_model_rejected(tmp_path):

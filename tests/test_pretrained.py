@@ -324,3 +324,28 @@ def test_lpips_manifest_roundtrip(tmp_path):
     _save(path, conv_w, conv_b, lin_w)
     params = load_lpips_params(path)
     assert len(params["conv_w"]) == 13 and len(params["lin_w"]) == 5
+
+
+def test_lpips_lin_keys_numeric_sort():
+    """The lin heads must order by the INTEGER in the "lin{i}" prefix, like
+    the conv keys do by feature index (the docstring's advertised numeric
+    sort): on a hypothetical net with >= 10 feature taps a string sort
+    would scramble lin10 before lin2 and pair every later head with the
+    wrong conv stage."""
+    import numpy as np
+
+    from tools.convert_lpips import state_dicts_to_arrays
+
+    vgg_sd = {
+        "0.weight": np.zeros((4, 3, 3, 3)), "0.bias": np.zeros(4),
+        "2.weight": np.zeros((4, 4, 3, 3)), "2.bias": np.zeros(4),
+    }
+    # 12 lin heads, channel count == head index so order is observable
+    lin_sd = {
+        f"lin{i}.model.1.weight": np.zeros((1, i + 1, 1, 1))
+        for i in range(12)
+    }
+    _, _, lin_w = state_dicts_to_arrays(vgg_sd, lin_sd)
+    assert [w.shape[1] for w in lin_w] == list(range(1, 13)), (
+        "lin heads not in numeric prefix order"
+    )

@@ -1,0 +1,546 @@
+"""Serving subsystem tests: cache byte-accounting, batcher coalescing,
+metrics registry, params-only checkpoint restore, and one end-to-end
+HTTP round trip on the procedural synthetic scene.
+
+The expensive pieces (one model init + one checkpoint save) happen ONCE in
+a module fixture; the e2e test pre-warms the engine's executable set and
+then asserts the acceptance criteria of the serving PR: concurrent renders
+against one cached MPI succeed with exactly 1 encoder invocation, cache hit
+rate >= 7/8, batcher coalescing >= 2 requests into at least one dispatch,
+and zero recompiles after warmup (bucket reuse).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mine_tpu.serving.batcher import MicroBatcher
+from mine_tpu.serving.cache import (
+    MPICache,
+    MPIEntry,
+    key_from_str,
+    key_to_str,
+    mpi_key,
+)
+from mine_tpu.serving.metrics import ServingMetrics
+from mine_tpu.utils.metrics import MetricsRegistry
+
+
+def _entry(h=2, w=2, s=2) -> MPIEntry:
+    return MPIEntry(
+        mpi_rgb=np.zeros((1, s, h, w, 3), np.float32),
+        mpi_sigma=np.zeros((1, s, h, w, 1), np.float32),
+        disparity=np.zeros((1, s), np.float32),
+        k=np.zeros((1, 3, 3), np.float32),
+        bucket=(h, w, s),
+    )
+
+
+# --------------------------------------------------------------- MPI cache
+
+
+def test_cache_byte_accounting_and_lru_eviction_order():
+    e = _entry()
+    per = e.nbytes
+    assert per == (2 * 2 * 2 * 3 + 2 * 2 * 2 * 1 + 2 + 9) * 4  # fp32 leaves
+
+    m = ServingMetrics()
+    cache = MPICache(byte_budget=3 * per, metrics=m)
+    keys = [mpi_key(f"img{i}", 7, (2, 2, 2)) for i in range(4)]
+    for k in keys[:3]:
+        cache.put(k, _entry())
+    assert len(cache) == 3 and cache.bytes_resident == 3 * per
+
+    # touch key0 so key1 becomes least-recently-USED (not least-recently
+    # inserted) — the next put must evict key1
+    assert cache.get(keys[0]) is not None
+    evicted = cache.put(keys[3], _entry())
+    assert evicted == [keys[1]]
+    assert set(cache.keys()) == {keys[0], keys[2], keys[3]}
+    assert cache.bytes_resident == 3 * per
+    assert cache.get(keys[1]) is None  # miss after eviction
+
+    assert m.cache_hits.value() == 1
+    assert m.cache_misses.value() == 1
+    assert m.cache_evictions.value() == 1
+    assert m.cache_bytes_resident.value() == 3 * per
+    assert m.cache_entries.value() == 3
+
+
+def test_cache_oversized_entry_admitted_after_evicting_everything():
+    e = _entry()
+    cache = MPICache(byte_budget=e.nbytes)  # budget fits exactly one
+    big = MPIEntry(
+        mpi_rgb=np.zeros((1, 8, 4, 4, 3), np.float32),
+        mpi_sigma=np.zeros((1, 8, 4, 4, 1), np.float32),
+        disparity=np.zeros((1, 8), np.float32),
+        k=np.zeros((1, 3, 3), np.float32),
+        bucket=(4, 4, 8),
+    )
+    assert big.nbytes > cache.byte_budget
+    k_small, k_big = mpi_key("s", 0, (2, 2, 2)), mpi_key("b", 0, (4, 4, 8))
+    cache.put(k_small, e)
+    evicted = cache.put(k_big, big)
+    # admitted (refusing would re-run the encoder on every render), small
+    # one evicted, overshoot visible in bytes_resident
+    assert evicted == [k_small]
+    assert cache.get(k_big) is not None
+    assert cache.bytes_resident == big.nbytes
+
+
+def test_cache_reput_same_key_replaces_without_leaking_bytes():
+    cache = MPICache(byte_budget=10 * _entry().nbytes)
+    k = mpi_key("img", 1, (2, 2, 2))
+    cache.put(k, _entry())
+    cache.put(k, _entry())
+    assert len(cache) == 1
+    assert cache.bytes_resident == _entry().nbytes
+
+
+def test_mpi_key_wire_roundtrip():
+    key = mpi_key("a" * 64, 1234, (384, 512, 32))
+    assert key_from_str(key_to_str(key)) == key
+    # digests can contain ':' never, but guard the parse anyway
+    assert key_to_str(key).count(":") == 4
+
+
+# ------------------------------------------------------------ micro-batcher
+
+
+def _fake_render(entry, poses):
+    """Deterministic stand-in: frame i's 'rgb' is pose i's translation, so
+    result-splitting across coalesced requests is verifiable."""
+    n = poses.shape[0]
+    rgb = poses[:, :3, 3].reshape(n, 1, 1, 3).astype(np.float32)
+    disp = np.full((n, 1, 1, 1), float(n), np.float32)  # dispatch size
+    return rgb, disp
+
+
+def _offsets_poses(offsets):
+    from mine_tpu.inference.trajectory import poses_from_offsets
+
+    return poses_from_offsets(np.asarray(offsets, np.float64))
+
+
+def test_batcher_coalesces_same_key_and_splits_results():
+    m = ServingMetrics()
+    dispatch_sizes = []
+
+    def render(entry, poses):
+        dispatch_sizes.append(poses.shape[0])
+        return _fake_render(entry, poses)
+
+    batcher = MicroBatcher(render, max_delay_ms=20.0, max_batch_poses=64,
+                           metrics=m)
+    key_a, key_b = mpi_key("a", 0, (2, 2, 2)), mpi_key("b", 0, (2, 2, 2))
+    entry = _entry()
+    # enqueue BEFORE starting the worker: the coalescing sweep is then
+    # deterministic (no timing races) — seed's deadline has already passed,
+    # so the group is exactly "everything same-key pending right now"
+    futs_a = [
+        batcher.submit(key_a, entry, _offsets_poses([[i, 0.0, 0.0]]))
+        for i in range(4)
+    ]
+    futs_b = [
+        batcher.submit(key_b, entry, _offsets_poses([[9.0 + i, 0.0, 0.0]]))
+        for i in range(2)
+    ]
+    assert batcher.queue_depth() == 6
+    batcher.start()
+    try:
+        for i, fut in enumerate(futs_a):
+            rgb, disp = fut.result(timeout=30)
+            assert rgb.shape == (1, 1, 1, 3)
+            assert rgb[0, 0, 0, 0] == float(i)  # own slice, not a neighbor's
+            assert disp[0, 0, 0, 0] == 4.0  # rendered in a 4-pose dispatch
+        for i, fut in enumerate(futs_b):
+            rgb, disp = fut.result(timeout=30)
+            assert rgb[0, 0, 0, 0] == 9.0 + i
+            assert disp[0, 0, 0, 0] == 2.0
+    finally:
+        batcher.stop()
+    assert dispatch_sizes == [4, 2]  # two dispatches for six requests
+    assert m.batch_requests.value() == 6
+    assert m.batch_dispatches.value() == 2
+    assert m.batch_coalesced_dispatches.value() == 2
+    assert m.batch_queue_depth.value() == 0
+
+
+def test_batcher_respects_max_batch_poses():
+    dispatch_sizes = []
+
+    def render(entry, poses):
+        dispatch_sizes.append(poses.shape[0])
+        return _fake_render(entry, poses)
+
+    batcher = MicroBatcher(render, max_delay_ms=0.0, max_batch_poses=3)
+    key = mpi_key("a", 0, (2, 2, 2))
+    futs = [
+        batcher.submit(key, _entry(), _offsets_poses([[float(i), 0, 0]]))
+        for i in range(5)
+    ]
+    batcher.start()
+    try:
+        for fut in futs:
+            fut.result(timeout=30)
+    finally:
+        batcher.stop()
+    assert dispatch_sizes == [3, 2]
+
+
+def test_batcher_never_overshoots_pose_ceiling():
+    """A candidate only joins a group if the WHOLE group still fits: at
+    n=2 of 3, a 2-pose candidate must be left pending (not absorbed into a
+    4-pose overshoot), while a 1-pose candidate behind it still fits."""
+    dispatch_sizes = []
+
+    def render(entry, poses):
+        dispatch_sizes.append(poses.shape[0])
+        return _fake_render(entry, poses)
+
+    batcher = MicroBatcher(render, max_delay_ms=0.0, max_batch_poses=3)
+    key = mpi_key("a", 0, (2, 2, 2))
+    sizes = (2, 2, 1)
+    futs = [
+        batcher.submit(key, _entry(), _offsets_poses(
+            [[float(i), 0.0, 0.0]] * n
+        ))
+        for i, n in enumerate(sizes)
+    ]
+    batcher.start()
+    try:
+        for fut in futs:
+            fut.result(timeout=30)
+    finally:
+        batcher.stop()
+    # seed(2) + skip(2, would overshoot) + absorb(1) -> 3; then the 2
+    assert dispatch_sizes == [3, 2]
+
+
+def test_batcher_propagates_render_errors_and_fails_stranded_on_stop():
+    def render(entry, poses):
+        raise RuntimeError("device fell over")
+
+    batcher = MicroBatcher(render, max_delay_ms=0.0)
+    fut = batcher.submit(mpi_key("a", 0, (2, 2, 2)), _entry(),
+                          _offsets_poses([[0, 0, 0]]))
+    batcher.start()
+    with pytest.raises(RuntimeError, match="device fell over"):
+        fut.result(timeout=30)
+    batcher.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        batcher.submit(mpi_key("a", 0, (2, 2, 2)), _entry(),
+                       _offsets_poses([[0, 0, 0]]))
+
+
+# --------------------------------------------------------- metrics registry
+
+
+def test_metrics_registry_prometheus_exposition():
+    r = MetricsRegistry()
+    c = r.counter("demo_total", "a counter")
+    c.inc()
+    c.inc(2, endpoint="render")
+    g = r.gauge("demo_bytes", "a gauge")
+    g.set(1.5)
+    s = r.summary("demo_seconds", "a summary")
+    for i in range(100):
+        s.observe(i / 100.0)
+    text = r.render()
+    assert "# TYPE demo_total counter" in text
+    assert "demo_total 1" in text
+    assert 'demo_total{endpoint="render"} 2' in text
+    assert "demo_bytes 1.5" in text
+    assert 'demo_seconds{quantile="0.5"} 0.5' in text
+    assert 'demo_seconds{quantile="0.95"} 0.94' in text
+    assert "demo_seconds_count 100" in text
+    assert text.endswith("\n")
+    # same-name re-registration returns the same family; kind mismatch raises
+    assert r.counter("demo_total", "again") is c
+    with pytest.raises(ValueError):
+        r.gauge("demo_total", "wrong kind")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+# ----------------------------------------- model fixture + serving e2e HTTP
+
+
+@pytest.fixture(scope="module")
+def served_workspace(tmp_path_factory):
+    """One tiny-model checkpoint on disk, shared by the restore test and the
+    e2e server test (model init is the dominant cost at this size)."""
+    import jax
+
+    from mine_tpu.config import Config
+    from mine_tpu.training import checkpoint as ckpt
+    from mine_tpu.training.optimizer import make_optimizer
+    from mine_tpu.training.step import build_model, init_state
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "mpi.num_bins_coarse": 4,
+    })
+    model = build_model(cfg)
+    state = init_state(
+        cfg, model, make_optimizer(cfg, 1), jax.random.PRNGKey(0)
+    )
+    workspace = str(tmp_path_factory.mktemp("serve_ws"))
+    ckpt.save_paired_config(cfg, workspace)
+    manager = ckpt.checkpoint_manager(workspace)
+    ckpt.save(manager, jax.device_get(state), 5)
+    ckpt.wait_until_finished(manager)
+    return workspace, cfg, state
+
+
+def test_load_for_serving_restores_params_only(served_workspace, tmp_path):
+    import jax
+
+    from mine_tpu.training.checkpoint import load_for_serving, save_paired_config
+
+    workspace, cfg, state = served_workspace
+    got_cfg, params, batch_stats, step = load_for_serving(workspace)
+    assert step == 5
+    assert got_cfg.data.img_h == 128 and got_cfg.mpi.num_bins_coarse == 4
+    # bitwise the trained params, no optimizer state materialized anywhere
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, jax.device_get(state.params),
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        batch_stats, jax.device_get(state.batch_stats),
+    )
+
+    # a workspace with config but no checkpoint must refuse by default
+    empty = str(tmp_path / "empty_ws")
+    import os
+
+    os.makedirs(empty)
+    save_paired_config(cfg, empty)
+    with pytest.raises(FileNotFoundError, match="allow_random_init"):
+        load_for_serving(empty)
+
+
+def _http(base: str, path: str, data=None, headers=None, timeout=180):
+    """Unlike tools/bench_serve.py's raising twin, 4xx/5xx return their
+    JSON bodies — the error surface is under test here."""
+    req = urllib.request.Request(base + path, data=data, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def _scene_png(phase: float = 0.7) -> bytes:
+    from PIL import Image
+
+    from mine_tpu.data.synthetic import _intrinsics, _render_view
+    from mine_tpu.inference.video import to_uint8
+
+    img, _ = _render_view(128, 128, _intrinsics(128, 128), np.zeros(3), phase)
+    buf = io.BytesIO()
+    Image.fromarray(to_uint8(img)).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+from tools.bench_serve import _metric_value  # noqa: E402 - one parser, not two
+
+
+def test_serving_end_to_end_http(served_workspace):
+    """The acceptance flow: /predict once, >= 8 concurrent /render requests
+    for novel poses, all succeed; /metrics shows exactly 1 encoder
+    invocation, cache hit rate >= 7/8, and >= 2 requests coalesced into one
+    dispatch at least once; warmup bounds compiles (a same-bucket repeat
+    request triggers NO recompile)."""
+    from mine_tpu.serving.server import ServingApp, make_server
+    from mine_tpu.training.checkpoint import load_for_serving
+
+    workspace, _, _ = served_workspace
+    cfg, params, batch_stats, step = load_for_serving(workspace)
+    app = ServingApp(
+        cfg, params, batch_stats, checkpoint_step=step,
+        cache_bytes=64 << 20,
+        # generous coalescing window: 8 test threads must land inside it
+        max_delay_ms=250.0,
+    )
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        # pre-warm the full executable set for this bucket: predict + the
+        # pose buckets the coalesced groups can land on
+        app.engine.warmup(pose_counts=(1, 2, 4, 8))
+        compiles_after_warmup = app.engine.compiles
+        assert compiles_after_warmup == 5  # 1 predict + render{1,2,4,8}
+
+        png = _scene_png()
+        status, body = _http(
+            base, "/predict", data=png, headers={"Content-Type": "image/png"}
+        )
+        assert status == 200, body
+        predict1 = json.loads(body)
+        assert predict1["cached"] is False
+        assert predict1["bucket"] == [128, 128, 4]
+        mpi_key_str = predict1["mpi_key"]
+        assert key_from_str(mpi_key_str)[1] == step  # checkpoint step in key
+
+        # same image bytes again: pure cache hit, no encoder pass
+        status, body = _http(
+            base, "/predict", data=png, headers={"Content-Type": "image/png"}
+        )
+        assert status == 200 and json.loads(body)["cached"] is True
+
+        # >= 8 concurrent renders of novel poses against the one cached MPI
+        results: list[tuple[int, dict]] = []
+        barrier = threading.Barrier(8)
+
+        def one_render(i: int) -> None:
+            barrier.wait()
+            payload = json.dumps({
+                "mpi_key": mpi_key_str,
+                "offsets": [[0.01 * (i + 1), 0.0, -0.02 * i]],
+            }).encode()
+            s, b = _http(base, "/render", data=payload,
+                         headers={"Content-Type": "application/json"})
+            results.append((s, json.loads(b)))
+
+        threads = [
+            threading.Thread(target=one_render, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(results) == 8
+        assert all(s == 200 for s, _ in results), results
+        for _, resp in results:
+            assert resp["num_frames"] == 1
+            assert resp["height"] == 128 and resp["width"] == 128
+            frame = np.asarray(
+                __import__("PIL.Image", fromlist=["Image"]).open(
+                    io.BytesIO(base64.b64decode(resp["frames_png_b64"][0]))
+                )
+            )
+            assert frame.shape == (128, 128, 3) and frame.dtype == np.uint8
+
+        # zero recompiles across predicts + concurrent renders: every
+        # request landed on a pre-warmed (bucket, pose-count) executable
+        assert app.engine.compiles == compiles_after_warmup
+
+        # the metrics surface carries the acceptance numbers
+        status, body = _http(base, "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert _metric_value(text, "mine_serve_encoder_invocations_total") == 1
+        hits = _metric_value(text, "mine_serve_cache_hits_total")
+        misses = _metric_value(text, "mine_serve_cache_misses_total")
+        assert hits / (hits + misses) >= 7 / 8, (hits, misses)
+        assert _metric_value(text, "mine_serve_batch_requests_total") == 8
+        dispatches = _metric_value(text, "mine_serve_batch_dispatches_total")
+        assert dispatches < 8  # coalescing happened
+        assert _metric_value(
+            text, "mine_serve_batch_coalesced_dispatches_total") >= 1
+        assert _metric_value(text, "mine_serve_rendered_frames_total") == 8
+        rps = _metric_value(text, "mine_serve_renders_per_sec")
+        assert np.isfinite(rps) and rps > 0
+        # latency summary present for both endpoints
+        assert 'mine_serve_request_latency_seconds{endpoint="render"' in text
+
+        # healthz snapshot
+        status, body = _http(base, "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok"
+        assert health["cache_entries"] == 1
+        assert health["buckets"] == [[128, 128, 4]]
+
+        # engine-level: pose padding to a bucket must not change results —
+        # N=3 pads into the 4-bucket; frame 0 must equal the 1-bucket render
+        entry = app.cache.get(key_from_str(mpi_key_str))
+        poses3 = _offsets_poses([[0.02, 0.0, 0.0], [0.0, 0.0, 0.0],
+                                 [0.0, 0.01, 0.0]])
+        rgb3, disp3 = app.engine.render(entry, poses3)
+        rgb1, disp1 = app.engine.render(entry, poses3[:1])
+        assert rgb3.shape == (3, 128, 128, 3) and disp3.shape == (3, 128, 128, 1)
+        np.testing.assert_allclose(rgb3[0], rgb1[0], atol=1e-6)
+        np.testing.assert_allclose(disp3[0], disp1[0], atol=1e-6)
+        assert app.engine.compiles == compiles_after_warmup  # still no recompile
+
+        # error surface: unknown MPI -> 404 with re-predict hint; bad bucket
+        # and bad body -> 400
+        status, body = _http(
+            base, "/render",
+            data=json.dumps({
+                "mpi_key": "feed:0:128:128:4", "offsets": [[0, 0, 0]],
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 404 and "predict" in json.loads(body)["error"]
+        status, body = _http(
+            base, "/predict",
+            data=json.dumps({
+                "image_b64": base64.b64encode(png).decode(),
+                "bucket": [100, 100, 4],
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        # not on the operator allowlist (which also catches non-128-multiple
+        # shapes before they could reach a compile)
+        assert status == 400 and "not served" in json.loads(body)["error"]
+        status, _ = _http(base, "/render", data=b"not json",
+                          headers={"Content-Type": "application/json"})
+        assert status == 400
+        status, body = _http(
+            base, "/render",
+            data=json.dumps({
+                "mpi_key": "garbage", "offsets": [[0, 0, 0]],
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 400  # malformed key is a client error, not a 500
+        status, _ = _http(base, "/predict", data=b"not an image at all",
+                          headers={"Content-Type": "image/png"})
+        assert status == 400  # UnidentifiedImageError (an OSError) -> 400
+        status, _ = _http(base, "/nope")
+        assert status == 404
+
+        # predict singleflight: concurrent uploads of one NEW image share a
+        # single encoder pass (the expensive-half analog of coalescing)
+        png2 = _scene_png(phase=2.9)
+        barrier2 = threading.Barrier(6)
+        predict_results: list[tuple[int, dict]] = []
+
+        def one_predict() -> None:
+            barrier2.wait()
+            s, b = _http(base, "/predict", data=png2,
+                         headers={"Content-Type": "image/png"})
+            predict_results.append((s, json.loads(b)))
+
+        threads2 = [threading.Thread(target=one_predict) for _ in range(6)]
+        for t in threads2:
+            t.start()
+        for t in threads2:
+            t.join(timeout=180)
+        assert len(predict_results) == 6
+        assert all(s == 200 for s, _ in predict_results)
+        keys2 = {r["mpi_key"] for _, r in predict_results}
+        assert len(keys2) == 1
+        _, body = _http(base, "/metrics")
+        assert _metric_value(
+            body.decode(), "mine_serve_encoder_invocations_total"
+        ) == 2  # the first image + exactly ONE pass for the 6-way race
+    finally:
+        server.shutdown()
+        app.close()

@@ -321,3 +321,29 @@ def test_script_syntax_and_stage_tables_aligned():
         capture_output=True, text=True, check=True,
     )
     assert out.stdout.split() == ["7", "7", "7", "7"], out.stdout
+
+
+def test_default_pause_pattern_anchored_to_interpreter():
+    """The production PAUSE_PAT default must anchor on the python
+    interpreter invoking tools/convergence_run.py: pkill -STOP -f matches
+    the WHOLE command line, so an unanchored "convergence_run.py" would
+    freeze an innocent `tail -f convergence_run.py.log` or grep during a
+    bench window (ADVICE r5) — and the startup -CONT self-heal would thaw
+    the same bystanders."""
+    src = WATCH.read_text()
+    m = re.search(r'TPU_WATCH_PAUSE_PAT:-(python[^}]+)\}', src)
+    assert m, "production PAUSE_PAT default not found or not python-anchored"
+    pat = m.group(1)
+    should_match = [
+        "python tools/convergence_run.py --steps 100",
+        "python3.11 /root/repo/tools/convergence_run.py",
+    ]
+    should_skip = [
+        "tail -f convergence_run.py.log",
+        "grep convergence_run.py notes.txt",
+        "vi tools/convergence_run.py",
+    ]
+    for cmd in should_match:
+        assert re.search(pat, cmd), f"pattern misses real run: {cmd}"
+    for cmd in should_skip:
+        assert not re.search(pat, cmd), f"pattern would freeze: {cmd}"

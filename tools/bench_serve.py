@@ -1,0 +1,292 @@
+#!/usr/bin/env python3
+"""Serving benchmark: renders/sec through the real HTTP surface.
+
+Starts the serving stack (mine_tpu/serving/) in-process on a localhost
+ephemeral port, predicts ONE MPI from the procedural synthetic scene
+(data/synthetic.py — nothing on disk), then hammers /render from concurrent
+clients and reports end-to-end throughput: PNG decode, HTTP, queueing,
+micro-batching, the jitted render-many dispatch, PNG encode — the number a
+capacity plan actually needs, unlike tools/bench_infer.py's device-only fps.
+
+Backend policy (the r05 lesson — the bench hung on TPU tunnel init and
+produced NOTHING): the TPU is probed in a SUBPROCESS with a hard timeout,
+so a dead tunnel cannot hang this process. Unreachable TPU => the bench
+degrades to a CPU measurement (recorded in the JSON) instead of timing out.
+
+Prints exactly one JSON line with metric "serve_renders_per_sec"; on
+failure {"metric", "value": null, "error"} (bench.py contract).
+
+  python tools/bench_serve.py                     # tiny CPU-friendly shape
+  python tools/bench_serve.py --h 384 --w 512 --planes 32   # recipe shape
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_SERVE_PROBE_TIMEOUT_S", "120"))
+RUN_TIMEOUT_S = int(os.environ.get("BENCH_SERVE_RUN_TIMEOUT_S", "1800"))
+
+METRIC = "serve_renders_per_sec"
+
+
+def _emit_failure(exc: BaseException) -> None:
+    print(json.dumps({
+        "metric": METRIC,
+        "value": None,
+        "unit": "imgs/sec",
+        "error": f"{type(exc).__name__}: {exc}"[:2000],
+        "note": "serving bench failed before producing a measurement",
+    }))
+
+
+def _arm_watchdog(secs: int):
+    """Print the failure JSON and hard-exit unless .set() within secs
+    (bench.py's watchdog pattern: a blocked C call never sees SIGALRM)."""
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(secs):
+            _emit_failure(TimeoutError(f"bench exceeded {secs}s"))
+            sys.stdout.flush()
+            os._exit(1)
+
+    threading.Thread(target=_watch, daemon=True, name="watchdog").start()
+    return done
+
+
+def _resolve_backend() -> str:
+    """Decide the backend BEFORE touching jax in this process.
+
+    JAX_PLATFORMS=cpu is honored as-is. Otherwise a subprocess (killable,
+    unlike an in-process hung PJRT init) probes the default backend; any
+    failure or timeout degrades this process to CPU.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu (JAX_PLATFORMS)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        if out.returncode == 0 and platform and platform != "cpu":
+            return platform  # accelerator reachable: use it
+        reason = f"probe rc={out.returncode} platform={platform!r}"
+    except subprocess.TimeoutExpired:
+        reason = f"probe hung > {PROBE_TIMEOUT_S}s (dead TPU tunnel?)"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return f"cpu (degraded: {reason})"
+
+
+def _http(base: str, path: str, data=None, headers=None, timeout=600):
+    req = urllib.request.Request(base + path, data=data, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _metric_value(text: str, name: str, default=0.0) -> float:
+    for line in text.splitlines():
+        if line.startswith(name) and (line[len(name)] in " {"):
+            return float(line.rsplit(" ", 1)[1])
+    return default
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--h", type=int, default=128)
+    ap.add_argument("--w", type=int, default=128)
+    ap.add_argument("--planes", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=48,
+                    help="measured /render requests")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="concurrent client threads")
+    ap.add_argument("--poses-per-request", type=int, default=1)
+    ap.add_argument("--max-delay-ms", type=float, default=4.0)
+    ap.add_argument("--workspace", default=None,
+                    help="serve a trained workspace instead of random init")
+    args = ap.parse_args()
+
+    backend_note = _resolve_backend()
+
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    init_ok = _arm_watchdog(RUN_TIMEOUT_S)
+
+    import jax
+    import numpy as np
+    from PIL import Image
+
+    from mine_tpu.data.synthetic import _intrinsics, _render_view
+    from mine_tpu.inference.video import to_uint8
+    from mine_tpu.serving.server import ServingApp, make_server
+
+    if args.workspace:
+        from mine_tpu.training.checkpoint import load_for_serving
+
+        cfg, params, batch_stats, step = load_for_serving(args.workspace)
+        cfg = cfg.replace(**{
+            "data.img_h": args.h, "data.img_w": args.w,
+            "mpi.num_bins_coarse": args.planes,
+        })
+    else:
+        from mine_tpu.config import Config
+        from mine_tpu.training.step import build_model
+
+        cfg = Config().replace(**{
+            "data.name": "synthetic",
+            "data.img_h": args.h, "data.img_w": args.w,
+            "model.num_layers": 18, "model.dtype": "float32",
+            "mpi.num_bins_coarse": args.planes,
+        })
+        model = build_model(cfg)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, args.h, args.w, 3)),
+            jax.numpy.linspace(1.0, 0.01, args.planes)[None],
+            True,
+        )
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        step = 0
+
+    app = ServingApp(
+        cfg, params, batch_stats, checkpoint_step=step,
+        max_delay_ms=args.max_delay_ms,
+    )
+    t0 = time.perf_counter()
+    # warm the pose buckets a coalesced group can land on (capped at the
+    # batcher's max batch), so the measurement is steady-state throughput
+    app.engine.warmup(pose_counts=tuple(
+        b for b in app.engine.pose_buckets
+        if b <= app.batcher.max_batch_poses
+    ))
+    compile_s = time.perf_counter() - t0
+
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    scene_img, _ = _render_view(
+        args.h, args.w, _intrinsics(args.h, args.w), np.zeros(3), 0.7
+    )
+    buf = io.BytesIO()
+    Image.fromarray(to_uint8(scene_img)).save(buf, format="PNG")
+    png = buf.getvalue()
+
+    status, body = _http(
+        base, "/predict", data=png, headers={"Content-Type": "image/png"}
+    )
+    assert status == 200, body
+    mpi_key = json.loads(body)["mpi_key"]
+
+    # payloads precomputed: numpy Generators are not thread-safe, and the
+    # timed window should measure serving, not client-side JSON assembly
+    rng = np.random.default_rng(0)
+
+    def render_payload(i: int) -> bytes:
+        offsets = (0.02 * rng.standard_normal(
+            (args.poses_per_request, 3))).tolist()
+        return json.dumps({"mpi_key": mpi_key, "offsets": offsets}).encode()
+
+    # warmup renders outside the timed window
+    for i in range(2):
+        _http(base, "/render", data=render_payload(i),
+              headers={"Content-Type": "application/json"})
+
+    errors: list[str] = []
+    work = [render_payload(i) for i in range(args.requests)]
+    work_lock = threading.Lock()
+
+    def client() -> None:
+        while True:
+            with work_lock:
+                if not work:
+                    return
+                payload = work.pop()
+            try:
+                s, _ = _http(base, "/render", data=payload,
+                             headers={"Content-Type": "application/json"})
+                if s != 200:
+                    errors.append(f"status {s}")
+            except Exception as exc:  # noqa: BLE001 - collected for the JSON
+                errors.append(f"{type(exc).__name__}: {exc}")
+
+    clients = [threading.Thread(target=client)
+               for _ in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for c in clients:
+        c.start()
+    for c in clients:
+        c.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)}/{args.requests} render requests failed: {errors[0]}"
+        )
+
+    frames = args.requests * args.poses_per_request
+    _, body = _http(base, "/metrics")
+    metrics_text = body.decode()
+    server.shutdown()
+    app.close()
+
+    init_ok.set()
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(frames / elapsed, 2),
+        "unit": "imgs/sec",
+        "h": args.h, "w": args.w, "planes": args.planes,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "poses_per_request": args.poses_per_request,
+        "elapsed_s": round(elapsed, 2),
+        "compile_s": round(compile_s, 1),
+        "render_p50_ms": round(1e3 * app.metrics.request_latency.quantile(
+            0.5, endpoint="render"), 1),
+        "render_p95_ms": round(1e3 * app.metrics.request_latency.quantile(
+            0.95, endpoint="render"), 1),
+        "encoder_invocations": _metric_value(
+            metrics_text, "mine_serve_encoder_invocations_total"),
+        "dispatches": _metric_value(
+            metrics_text, "mine_serve_batch_dispatches_total"),
+        "coalesced_dispatches": _metric_value(
+            metrics_text, "mine_serve_batch_coalesced_dispatches_total"),
+        "backend": backend_note,
+        "device": jax.devices()[0].device_kind,
+        "note": (
+            "end-to-end through HTTP (PNG decode/encode + queueing + "
+            "micro-batching + jitted render-many); one MPI predicted once, "
+            "all renders cache hits"
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 - emit-then-reraise contract
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_failure(exc)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)

@@ -22,6 +22,7 @@ key/shape checks raise on drift rather than mis-mapping.
 from __future__ import annotations
 
 import argparse
+import re
 
 import numpy as np
 
@@ -41,7 +42,15 @@ def state_dicts_to_arrays(vgg_sd: dict, lin_sd: dict):
     )
     conv_w = [to_np(vgg_sd[k + ".weight"]) for k in conv_keys]
     conv_b = [to_np(vgg_sd[k + ".bias"]) for k in conv_keys]
-    lin_w = [to_np(lin_sd[k]) for k in sorted(lin_sd) if "model" in k or "weight" in k]
+    # same numeric discipline for the lin heads: keys are "lin{i}.model..."
+    # (published layout) and must order by the integer in the prefix — a
+    # plain string sort would put lin10 before lin2 on any net with >= 10
+    # feature taps, silently pairing weights with the wrong conv stage
+    lin_keys = sorted(
+        (k for k in lin_sd if "model" in k or "weight" in k),
+        key=lambda k: int(re.sub(r"\D", "", k.split(".")[0])),
+    )
+    lin_w = [to_np(lin_sd[k]) for k in lin_keys]
     return conv_w, conv_b, lin_w
 
 

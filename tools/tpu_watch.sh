@@ -43,7 +43,12 @@ if [ "$DRYRUN" = 1 ]; then
     # explicitly names (empty -> no-op), never a real background job
     PAUSE_PAT="${TPU_WATCH_PAUSE_PAT:-}"
 else
-    PAUSE_PAT="${TPU_WATCH_PAUSE_PAT:-convergence_run.py}"
+    # anchored to the interpreter invocation: pkill -f matches the WHOLE
+    # command line, so a bare "convergence_run.py" would also freeze an
+    # unrelated `tail -f convergence_run.py.log` or a grep over it during a
+    # bench window (ADVICE r5); the startup -CONT self-heal below uses the
+    # same anchored pattern so it can only thaw what this pattern froze
+    PAUSE_PAT="${TPU_WATCH_PAUSE_PAT:-python[0-9.]* .*tools/convergence_run\.py}"
 fi
 
 STAGE_NAMES=(bench warp_fullres warp_384 width64 warp_384c4 infer infer_highres)
