@@ -14,11 +14,19 @@ repo publishes no throughput anywhere (SURVEY.md §6) and no GPU exists here
 to measure its recipe on, so there is no honest denominator — the north-star
 comparison (>=4x PyTorch-V100, BASELINE.md) awaits a measured V100 number.
 
+Backend policy (the BENCH_r01-r05 lesson — five consecutive rounds produced
+`value: null` on the hung TPU tunnel): the TPU is probed in a SUBPROCESS
+with a hard timeout before jax is touched in this process; an unreachable
+or hung backend degrades to a labeled CPU measurement (the `backend` field
+records why) instead of dying at the watchdog with nothing.
+
 Prints exactly one JSON line:
   {"metric", "value", "unit", "vs_baseline", "flops_per_step",
    "model_tflops_per_sec", "mfu", "step_ms", "mosaic_kernel_calls",
-   "width_multiple", "device", "note"} plus *_b8 twins for the optional
-  second point; on failure {"metric", "value": null, "error", "note"}.
+   "width_multiple", "device", "backend", "note"} plus *_b8 twins for the
+  optional second point; on failure {"metric", "value": null, "error",
+  "note"} — reachable now only by a genuine in-run crash, not by the
+  tunnel being dead.
 """
 
 from __future__ import annotations
@@ -31,6 +39,12 @@ import time
 BATCH = 2
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
+# the degraded-to-CPU measurement keeps the same workload but fewer timed
+# steps: a CPU step is ~2 orders slower and the point of the fallback is a
+# labeled, non-null number inside the timeout budget, not CPU rigor
+CPU_WARMUP_STEPS = 1
+CPU_MEASURE_STEPS = 2
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
 
 # The tunneled TPU backend has two failure modes: a clean UNAVAILABLE error
 # (round 3) and an indefinite HANG inside PJRT client creation (observed
@@ -43,19 +57,11 @@ RUN_TIMEOUT_S = int(os.environ.get("BENCH_RUN_TIMEOUT_S", "2400"))
 
 
 def _arm_watchdog(secs: int, what: str):
-    """Emit the failure JSON and os._exit(1) unless .set() within secs."""
-    import threading
+    """Emit the failure JSON and os._exit(1) unless .set() within secs
+    (the shared deadline discipline, mine_tpu/utils/platform.py)."""
+    from mine_tpu.utils.platform import arm_watchdog
 
-    done = threading.Event()
-
-    def _watch():
-        if not done.wait(secs):
-            _emit_failure(TimeoutError(f"{what} exceeded {secs}s (hung TPU tunnel?)"))
-            sys.stdout.flush()
-            os._exit(1)
-
-    threading.Thread(target=_watch, daemon=True, name=f"watchdog-{what}").start()
-    return done
+    return arm_watchdog(secs, _emit_failure, what)
 
 # Published dense bf16 peak FLOP/s PER JAX DEVICE (what the executable and
 # its cost analysis run on). On v2/v3 a jax device is one core (half a chip:
@@ -117,7 +123,25 @@ def mosaic_kernel_calls(compiled) -> int | None:
         return None
 
 
+def _resolve_backend() -> str:
+    """Probe-or-degrade backend policy, shared across the bench entry
+    points (adopted after five consecutive rounds produced `value: null`
+    on the hung tunnel — mine_tpu/utils/platform.py has the details)."""
+    from mine_tpu.utils.platform import resolve_backend_probe
+
+    return resolve_backend_probe(PROBE_TIMEOUT_S)
+
+
 def main() -> None:
+    backend_note = _resolve_backend()
+    on_cpu = backend_note.startswith("cpu")
+    if on_cpu:
+        # make JAX_PLATFORMS=cpu stick even against self-registering
+        # accelerator plugins (mine_tpu/utils/platform.py)
+        from mine_tpu.utils.platform import honor_jax_platforms
+
+        honor_jax_platforms()
+
     import jax
 
     from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
@@ -128,7 +152,7 @@ def main() -> None:
     jax.devices()
     init_ok.set()
     run_ok = _arm_watchdog(RUN_TIMEOUT_S, "benchmark run")
-    _run()
+    _run(backend_note, on_cpu)
     run_ok.set()
 
 
@@ -138,7 +162,12 @@ def main() -> None:
 _RESULT_SO_FAR: dict | None = None
 
 
-def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
+def _measure_point(
+    batch_size: int,
+    profile_dir: str | None = None,
+    warmup_steps: int = WARMUP_STEPS,
+    measure_steps: int = MEASURE_STEPS,
+) -> dict:
     """One (compile, warm, time) cycle of the full train step at a given
     per-device batch size. Returns imgs/sec + XLA-cost-analysis MFU fields."""
     import jax
@@ -186,7 +215,7 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
 
     def compile_and_warm(state, step):
         compiled = step.lower(state, batch).compile()
-        for _ in range(WARMUP_STEPS):
+        for _ in range(warmup_steps):
             state, loss_dict = compiled(state, batch)
         force(state, loss_dict)
         return compiled, state, loss_dict
@@ -215,17 +244,17 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
         print(f"# profile trace written to {profile_dir}", file=sys.stderr)
 
     t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
+    for _ in range(measure_steps):
         state, loss_dict = compiled(state, batch)
     force(state, loss_dict)
     elapsed = time.perf_counter() - t0
 
-    imgs_per_sec = batch_size * MEASURE_STEPS / elapsed
+    imgs_per_sec = batch_size * measure_steps / elapsed
     flops_per_step = executable_flops(compiled)
     device = jax.devices()[0]
     peak = chip_peak_flops(device.device_kind)
     model_flops_per_sec = (
-        flops_per_step * MEASURE_STEPS / elapsed if flops_per_step else None
+        flops_per_step * measure_steps / elapsed if flops_per_step else None
     )
     mfu = (
         round(model_flops_per_sec / peak, 4)
@@ -238,7 +267,7 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
             round(model_flops_per_sec / 1e12, 3) if model_flops_per_sec else None
         ),
         "mfu": mfu,
-        "step_ms": round(elapsed / MEASURE_STEPS * 1e3, 1),
+        "step_ms": round(elapsed / measure_steps * 1e3, 1),
         "mosaic_kernel_calls": mosaic_kernel_calls(compiled),
         "remat": remat_used,
         "width_multiple": width_multiple,
@@ -246,10 +275,16 @@ def _measure_point(batch_size: int, profile_dir: str | None = None) -> dict:
     }
 
 
-def _run() -> None:
+def _run(backend_note: str = "", on_cpu: bool = False) -> None:
     global _RESULT_SO_FAR
     profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
-    primary = _measure_point(BATCH, profile_dir=profile_dir)
+    if on_cpu:
+        primary = _measure_point(
+            BATCH, profile_dir=profile_dir,
+            warmup_steps=CPU_WARMUP_STEPS, measure_steps=CPU_MEASURE_STEPS,
+        )
+    else:
+        primary = _measure_point(BATCH, profile_dir=profile_dir)
 
     result = {
         "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
@@ -263,6 +298,7 @@ def _run() -> None:
         "mosaic_kernel_calls": primary["mosaic_kernel_calls"],
         "width_multiple": primary["width_multiple"],
         "device": primary["device"],
+        "backend": backend_note,
         "note": (
             "vs_baseline awaits a reference denominator on comparable "
             "hardware (the reference repo publishes no throughput, SURVEY.md "
@@ -280,8 +316,9 @@ def _run() -> None:
     # second point at per-device batch 8: B=2 is recipe parity, not a TPU
     # limit; larger batches amortize small-conv overheads on the MXU.
     # Opt out with BENCH_SECOND_POINT=0 (e.g. when the tunnel is flaky and
-    # one compile is all the budget allows).
-    if os.environ.get("BENCH_SECOND_POINT", "1") != "0":
+    # one compile is all the budget allows); skipped on the CPU fallback —
+    # a B=8 CPU compile+run buys nothing and risks the run watchdog.
+    if not on_cpu and os.environ.get("BENCH_SECOND_POINT", "1") != "0":
         try:
             b8 = _measure_point(8)
             result["value_b8"] = b8["value"]

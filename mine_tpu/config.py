@@ -89,6 +89,15 @@ class MPIConfig:
     # optional explicit bin-edge list, len == num_bins_coarse + 1
     # (synthesis_task.py:37-52)
     disparity_list: tuple[float, ...] = ()
+    # target-view compositor: "dense" materializes every warped plane before
+    # compositing (the reference's layout); "streaming" scans plane chunks
+    # carrying only running accumulators — O(chunk·H·W) working set instead
+    # of O(S·H·W), fused warp-composite Pallas forward on TPU. A numerics
+    # no-op (ops/mpi_render.py compositor_from_config; PARITY.md)
+    compositor: str = "dense"
+    # planes per streaming-scan step (clamped to the largest divisor of the
+    # plane count); only read when compositor == "streaming"
+    stream_chunk_planes: int = 4
 
 
 @dataclass(frozen=True)

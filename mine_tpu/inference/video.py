@@ -76,6 +76,12 @@ def render_many_fn(
     disparity (N, H, W, 1)), all computed in one jitted on-device `lax.map`
     (the reference's per-frame python loop, image_to_video.py:227-245).
     Intrinsics are shared between source and target (single-image app).
+
+    The per-pose warp+composite resolves cfg.mpi.compositor inside
+    render_novel_view: with "streaming" each frame's (S, H, W, C) warped
+    slab is never materialized (ops/mpi_render.py), which is what lets the
+    serving engine grow its resident-MPI render buckets
+    (serving/engine.py defaults its bucket configs to streaming).
     """
     k_inv = ops.inverse_3x3(k)
 

@@ -24,15 +24,20 @@ from mine_tpu.ops.homography import (
 from mine_tpu.ops.mpi_render import (
     Compositor,
     DENSE_COMPOSITOR,
+    STREAMING_COMPOSITOR,
     alpha_composition,
+    compositor_from_config,
+    plane_tgt_xyz,
     plane_volume_rendering,
     ray_norms,
-    weighted_sum_mpi,
-    weighted_sum_src,
     render,
     render_src,
     render_tgt_rgb_depth,
+    render_tgt_rgb_depth_streaming,
+    streaming_compositor,
     warp_mpi_to_tgt,
+    weighted_sum_mpi,
+    weighted_sum_src,
 )
 from mine_tpu.ops.sampling import (
     uniform_disparity_from_linspace_bins,

@@ -10,16 +10,24 @@ by mine_tpu/parallel/plane_sharding.py with an explicit cross-device prefix.
 
 from __future__ import annotations
 
+import os
+from functools import partial
 from typing import Callable, NamedTuple
 
+import jax
 import jax.numpy as jnp
-from jax import Array
+from jax import Array, lax
 
 from mine_tpu.ops.geometry import _PRECISION, homogeneous_pixel_grid
 from mine_tpu.ops.homography import homography_sample_coords
 from mine_tpu.ops.grid_sample import grid_sample_pixel
 
 _BG_DIST = 1.0e3  # pseudo-distance behind the farthest plane (mpi_rendering.py:50)
+
+# planes per lax.scan step of the streaming target compositor (the live
+# working set is chunk/S of the dense path's); cfg.mpi.stream_chunk_planes
+# overrides it through compositor_from_config
+DEFAULT_STREAM_CHUNK = 4
 
 
 def _shifted_exclusive(x: Array, fill: float = 1.0) -> Array:
@@ -199,6 +207,46 @@ def render_src(
     return rgb_out, depth_out, transparency_acc, weights
 
 
+def _affine_tgt_xyz(
+    src_xy: Array, depth: Array, g_flat: Array, k_inv_flat: Array,
+    h: int, w: int,
+) -> Array:
+    """The analytic xyz sample: evaluate the per-plane affine at the clamped
+    warp coords (fp32 throughout, like all coordinate math).
+
+    src_xy: (N, H, W, 2); depth: (N,); g_flat: (N, 4, 4);
+    k_inv_flat: (N, 3, 3). Returns (N, H, W, 3) target-frame plane xyz.
+    """
+    qx = jnp.clip(src_xy[..., 0:1], 0.0, float(w - 1))
+    qy = jnp.clip(src_xy[..., 1:2], 0.0, float(h - 1))
+    q_homo = jnp.concatenate([qx, qy, jnp.ones_like(qx)], axis=-1)
+    m = jnp.einsum(
+        "nij,njk->nik", g_flat[:, :3, :3], k_inv_flat, precision=_PRECISION
+    ) * depth[:, None, None]
+    return (
+        jnp.einsum("nij,nhwj->nhwi", m, q_homo, precision=_PRECISION)
+        + g_flat[:, None, None, :3, 3]
+    )
+
+
+def plane_tgt_xyz(
+    depth: Array, g_tgt_src: Array, k_src_inv: Array, k_tgt: Array,
+    h: int, w: int,
+) -> Array:
+    """Target-frame xyz of ONE plane per batch item at its own warp coords —
+    pure coordinate math, no gather. depth: (B,). Returns (B, H, W, 3).
+
+    Bitwise-identical to the xyz warp_mpi_to_tgt produces for the same plane
+    (same homography + affine formulas on the same inputs), which is what
+    lets the streaming scan compute the chunk-boundary halo plane's xyz
+    without touching the next chunk's payload.
+    """
+    src_xy, _ = homography_sample_coords(
+        depth, g_tgt_src, k_src_inv, k_tgt, h, w
+    )
+    return _affine_tgt_xyz(src_xy, depth, g_tgt_src, k_src_inv, h, w)
+
+
 def warp_mpi_to_tgt(
     mpi_rgb_src: Array,
     mpi_sigma_src: Array,
@@ -241,17 +289,8 @@ def warp_mpi_to_tgt(
     )
     warped = grid_sample_pixel(payload, src_xy).astype(payload.dtype)
 
-    # the analytic xyz sample: evaluate the per-plane affine at the clamped
-    # coords (fp32 throughout, like all coordinate math)
-    qx = jnp.clip(src_xy[..., 0:1], 0.0, float(w - 1))
-    qy = jnp.clip(src_xy[..., 1:2], 0.0, float(h - 1))
-    q_homo = jnp.concatenate([qx, qy, jnp.ones_like(qx)], axis=-1)
-    m = jnp.einsum(
-        "nij,njk->nik", g_flat[:, :3, :3], k_inv_flat, precision=_PRECISION
-    ) * depth.reshape(b * s)[:, None, None]
-    tgt_xyz = (
-        jnp.einsum("nij,nhwj->nhwi", m, q_homo, precision=_PRECISION)
-        + g_flat[:, None, None, :3, 3]
+    tgt_xyz = _affine_tgt_xyz(
+        src_xy, depth.reshape(b * s), g_flat, k_inv_flat, h, w
     )
 
     warped = warped.reshape(b, s, h, w, 4)
@@ -317,3 +356,348 @@ class Compositor(NamedTuple):
 
 
 DENSE_COMPOSITOR = Compositor(render_src, weighted_sum_src, render_tgt_rgb_depth)
+
+
+# -- streaming target compositor ---------------------------------------------
+#
+# render_tgt_rgb_depth materializes every warped plane before compositing —
+# the reference's memory ceiling ("memory consumption is huge, only one
+# supervision is allowed", synthesis_task.py:203-204), inherited by the dense
+# path: at the LLFF recipe (384x512, S=32, fp32) the warped rgb+sigma+xyz
+# intermediates are ~125 MB per batch item, all HBM round-trips. But
+# over-compositing is a prefix product over S, so the plane axis can be
+# STREAMED: a lax.scan over plane chunks carrying only the running
+# (rgb, depth-z, weight, mask, transmittance) accumulators — O(H·W) working
+# set instead of O(S·H·W); the (B, S, H, W, C) warped tensors never exist.
+# The chunk boundary needs exactly one halo quantity: the next chunk's first
+# plane's xyz, which is analytic in its depth (plane_tgt_xyz) — a (B,)
+# scalar ships where the reference would ship a plane.
+
+
+def _chunk_size(s: int, requested: int) -> int:
+    """Largest divisor of the plane count <= the requested chunk size, so an
+    odd S (e.g. a coarse+fine merge) degrades to smaller chunks instead of
+    failing; >= 1 always."""
+    requested = max(1, min(int(requested), s))
+    for d in range(requested, 0, -1):
+        if s % d == 0:
+            return d
+    return 1
+
+
+def _stream_scan(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    halo_depth: Array,
+    bg_on_last,
+    use_alpha: bool,
+    chunk: int,
+) -> tuple[Array, Array, Array, Array, Array]:
+    """The chunked streaming composite over the plane axis (core of both the
+    unsharded streaming compositor and the plane-sharded local scan).
+
+    Scans S/chunk chunks carrying only (B, H, W, ·) accumulators; each step
+    warps a (B, chunk, H, W, ·) slab that dies at the next step, and the
+    body is jax.checkpoint'd so the reverse scan RECOMPUTES the per-plane
+    warps instead of saving them — neither pass holds (B, S, H, W, ·).
+
+    halo_depth: (B,) depth of the plane AFTER the last plane here (any value
+    when bg_on_last puts the background pseudo-distance there instead).
+    bg_on_last: bool (python or traced) — whether the globally-last plane
+    lives in this plane range (False on all but the last device of a
+    plane-sharded mesh).
+
+    Returns (rgb_sum, z_sum, weight_sum, mask_sum, trans_total) with initial
+    transmittance 1. Every sum is LINEAR in the incoming transmittance, so a
+    plane-sharded caller scales the partials by its cross-device exclusive
+    prefix afterwards (parallel/plane_sharding.py).
+    """
+    b, s, h, w, _ = mpi_rgb_src.shape
+    assert s % chunk == 0, (s, chunk)
+    n_chunks = s // chunk
+    depth = 1.0 / mpi_disparity_src  # (B, S)
+
+    def chunked(x: Array) -> Array:  # (B, S, ...) -> (n_chunks, B, chunk, ...)
+        xm = jnp.moveaxis(x, 1, 0)
+        return jnp.moveaxis(xm.reshape((n_chunks, chunk) + xm.shape[1:]), 1, 2)
+
+    # depth of the plane after each chunk's last plane: the next chunk's
+    # first plane; the trailing chunk takes the caller's halo
+    depth_chunk_first = depth.reshape(b, n_chunks, chunk)[:, 1:, 0]  # (B, n-1)
+    next_depth = jnp.concatenate(
+        [jnp.moveaxis(depth_chunk_first, 1, 0), halo_depth[None]], axis=0
+    )  # (n_chunks, B)
+    xs = {
+        "rgb": chunked(mpi_rgb_src),
+        "sigma": chunked(mpi_sigma_src),
+        "disp": jnp.moveaxis(
+            mpi_disparity_src.reshape(b, n_chunks, chunk), 1, 0
+        ),
+        "next_depth": next_depth,
+        "is_last": jnp.arange(n_chunks) == n_chunks - 1,
+    }
+
+    last_plane = (jnp.arange(chunk) == chunk - 1).reshape(1, chunk, 1, 1, 1)
+
+    def body(carry, x):
+        rgb_acc, z_acc, w_acc, m_acc, t_acc = carry
+        tgt_rgb, tgt_sigma, tgt_xyz, valid = warp_mpi_to_tgt(
+            x["rgb"], x["sigma"], x["disp"], g_tgt_src, k_src_inv, k_tgt
+        )
+        z = tgt_xyz[..., 2:3]  # (B, chunk, H, W, 1)
+        if use_alpha:
+            alpha = tgt_sigma
+            trans_local = jnp.cumprod(1.0 - alpha, axis=1)
+        else:
+            xyz_next = plane_tgt_xyz(
+                x["next_depth"], g_tgt_src, k_src_inv, k_tgt, h, w
+            )
+            xyz_ext = jnp.concatenate([tgt_xyz, xyz_next[:, None]], axis=1)
+            diff = xyz_ext[:, 1:] - xyz_ext[:, :-1]
+            # the background slot's diff must be replaced BEFORE the norm
+            # (d||v||/dv at v=0 is 0/0 — same NaN-cotangent guard as
+            # parallel/plane_sharding.py)
+            bg_mask = jnp.logical_and(
+                jnp.logical_and(x["is_last"], bg_on_last), last_plane
+            )
+            diff = jnp.where(bg_mask, 1.0, diff)
+            dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)
+            dist = jnp.where(bg_mask, _BG_DIST, dist)
+            transparency = jnp.exp(-tgt_sigma * dist)
+            alpha = 1.0 - transparency
+            trans_local = jnp.cumprod(transparency + 1.0e-6, axis=1)
+        weights = t_acc[:, None] * _shifted_exclusive(trans_local) * alpha
+        return (
+            rgb_acc + jnp.sum(weights * tgt_rgb, axis=1),
+            z_acc + jnp.sum(weights * z, axis=1),
+            w_acc + jnp.sum(weights, axis=1),
+            m_acc + jnp.sum(valid.astype(mpi_rgb_src.dtype), axis=1),
+            t_acc * trans_local[:, -1],
+        ), None
+
+    dtype = mpi_rgb_src.dtype
+    init = (
+        jnp.zeros((b, h, w, 3), dtype),
+        jnp.zeros((b, h, w, 1), dtype),
+        jnp.zeros((b, h, w, 1), dtype),
+        jnp.zeros((b, h, w), dtype),
+        jnp.ones((b, h, w, 1), dtype),
+    )
+    carry, _ = lax.scan(jax.checkpoint(body), init, xs)
+    return carry
+
+
+def _finalize_depth(
+    z_sum: Array, w_sum: Array, use_alpha: bool, is_bg_depth_inf: bool
+) -> Array:
+    """Composited z partial sums -> depth, matching the dense reductions
+    (alpha_composition / weighted_sum_mpi tails)."""
+    if use_alpha:
+        return z_sum
+    if is_bg_depth_inf:
+        return z_sum + (1.0 - w_sum) * 1000.0
+    return z_sum / (w_sum + 1.0e-5)
+
+
+def _render_tgt_scan(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+    chunk_planes: int = DEFAULT_STREAM_CHUNK,
+) -> tuple[Array, Array, Array]:
+    """The pure-scan streaming twin of render_tgt_rgb_depth (same contract)."""
+    depth = 1.0 / mpi_disparity_src
+    chunk = _chunk_size(mpi_rgb_src.shape[1], chunk_planes)
+    rgb_sum, z_sum, w_sum, mask, _ = _stream_scan(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+        g_tgt_src, k_src_inv, k_tgt,
+        halo_depth=depth[:, -1], bg_on_last=True,
+        use_alpha=use_alpha, chunk=chunk,
+    )
+    depth_out = _finalize_depth(z_sum, w_sum, use_alpha, is_bg_depth_inf)
+    return rgb_sum, depth_out, mask[..., None]
+
+
+# tests force the fused Pallas path in interpret mode through this flag
+# (Mosaic itself is TPU-only); production dispatch is _fused_engaged
+_FORCE_FUSED_INTERPRET = False
+
+
+def _fused_engaged() -> bool:
+    """The fused warp-composite Pallas kernel runs on TPU unless opted out
+    (same escape-hatch idiom as the warp kernels, ops/grid_sample.py)."""
+    if _FORCE_FUSED_INTERPRET:
+        return True
+    return (
+        jax.default_backend() == "tpu"
+        and os.environ.get("MINE_TPU_DISABLE_FUSED_COMPOSITE", "").lower()
+        not in ("1", "true", "yes", "on")
+    )
+
+
+def _fused_forward(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    is_bg_depth_inf: bool,
+) -> tuple[Array, Array, Array]:
+    """Forward streaming composite through the fused warp-composite kernel
+    (ops/pallas/warp.py warp_composite_chw): per output tile the kernel
+    DMA's each plane's source band, gathers, and over-composites into
+    resident VMEM accumulators — one HBM pass for the whole sweep, no warped
+    (B, S, H, W, C) tensor and none of the dense path's cumprod-chain
+    intermediates. The coordinate prep (coords/dist/z, ~4 floats per plane
+    pixel) is the only S-sized traffic besides the MPI itself."""
+    from mine_tpu.ops.pallas.warp import warp_composite_chw
+
+    b, s, h, w, _ = mpi_rgb_src.shape
+    depth = (1.0 / mpi_disparity_src).reshape(b * s)
+    tile = lambda m: jnp.repeat(m, s, axis=0)
+    g_flat = tile(g_tgt_src)
+    k_inv_flat = tile(k_src_inv)
+    src_xy, _ = homography_sample_coords(
+        depth, g_flat, k_inv_flat, tile(k_tgt), h, w
+    )
+    xyz = _affine_tgt_xyz(src_xy, depth, g_flat, k_inv_flat, h, w)
+    xyz = xyz.reshape(b, s, h, w, 3)
+    dist = jnp.linalg.norm(xyz[:, 1:] - xyz[:, :-1], axis=-1)
+    dist = jnp.concatenate(
+        [dist, jnp.full_like(dist[:, :1], _BG_DIST)], axis=1
+    )  # (B, S, H, W)
+
+    payload = jnp.concatenate([mpi_rgb_src, mpi_sigma_src], axis=-1)
+    payload = jnp.moveaxis(payload, -1, 2)  # (B, S, 4, H, W)
+    coords = src_xy.reshape(b, s, h, w, 2)
+    acc = warp_composite_chw(
+        payload, coords[..., 0], coords[..., 1], dist, xyz[..., 2],
+        interpret=_FORCE_FUSED_INTERPRET,
+    )  # (B, 7, H, W): rgb(3), z_sum, w_sum, valid count, transmittance
+    rgb_out = jnp.moveaxis(acc[:, 0:3], 1, -1)
+    z_sum = acc[:, 3][..., None]
+    w_sum = acc[:, 4][..., None]
+    mask = acc[:, 5][..., None]
+    depth_out = _finalize_depth(
+        z_sum, w_sum, use_alpha=False, is_bg_depth_inf=is_bg_depth_inf
+    )
+    return rgb_out, depth_out, mask
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7))
+def _render_tgt_fused(
+    mpi_rgb_src, mpi_sigma_src, mpi_disparity_src, g_tgt_src, k_src_inv,
+    k_tgt, is_bg_depth_inf, chunk_planes,
+):
+    """Fused-forward / scan-recompute-backward streaming render: the Pallas
+    kernel owns the forward sweep, and the backward re-runs the chunked scan
+    under jax.vjp — the per-plane warps are recomputed in the reverse scan,
+    never saved (the remat discipline the scan path already has)."""
+    return _fused_forward(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+        g_tgt_src, k_src_inv, k_tgt, is_bg_depth_inf,
+    )
+
+
+def _render_tgt_fused_fwd(
+    mpi_rgb_src, mpi_sigma_src, mpi_disparity_src, g_tgt_src, k_src_inv,
+    k_tgt, is_bg_depth_inf, chunk_planes,
+):
+    out = _fused_forward(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+        g_tgt_src, k_src_inv, k_tgt, is_bg_depth_inf,
+    )
+    res = (mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+           g_tgt_src, k_src_inv, k_tgt)
+    return out, res
+
+
+def _render_tgt_fused_bwd(is_bg_depth_inf, chunk_planes, res, ct):
+    def scan_path(*args):
+        return _render_tgt_scan(
+            *args, use_alpha=False, is_bg_depth_inf=is_bg_depth_inf,
+            chunk_planes=chunk_planes,
+        )
+
+    _, vjp = jax.vjp(scan_path, *res)
+    return vjp(ct)
+
+
+_render_tgt_fused.defvjp(_render_tgt_fused_fwd, _render_tgt_fused_bwd)
+
+
+def render_tgt_rgb_depth_streaming(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+    chunk_planes: int = DEFAULT_STREAM_CHUNK,
+) -> tuple[Array, Array, Array]:
+    """Streaming twin of render_tgt_rgb_depth — same signature, same outputs
+    to fp-reassociation precision (the chunked prefix product rounds in a
+    different order; parity pinned at 1e-5 by tests/test_mpi_render.py).
+
+    On TPU the sigma-compositing forward runs through the fused
+    warp-composite Pallas kernel (one HBM pass per sweep); everywhere else —
+    and for every backward — a jax.checkpoint'd lax.scan over plane chunks
+    keeps the working set at O(chunk·H·W).
+    """
+    chunk = _chunk_size(mpi_rgb_src.shape[1], chunk_planes)
+    if not use_alpha and _fused_engaged():
+        return _render_tgt_fused(
+            mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+            g_tgt_src, k_src_inv, k_tgt, is_bg_depth_inf, chunk,
+        )
+    return _render_tgt_scan(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+        g_tgt_src, k_src_inv, k_tgt,
+        use_alpha=use_alpha, is_bg_depth_inf=is_bg_depth_inf,
+        chunk_planes=chunk,
+    )
+
+
+def streaming_compositor(
+    chunk_planes: int = DEFAULT_STREAM_CHUNK,
+) -> Compositor:
+    """The streaming peer of DENSE_COMPOSITOR. Only the target-view render
+    streams: the source sweep's per-plane WEIGHTS feed src-RGB blending
+    (training/step.py loss_fcn_per_scale), so render_src must keep them
+    materialized — and it already builds no (B, S, H, W, 3) xyz (its
+    distances factor into an (S,) x (H, W) product)."""
+    return Compositor(
+        render_src,
+        weighted_sum_src,
+        partial(render_tgt_rgb_depth_streaming, chunk_planes=chunk_planes),
+    )
+
+
+STREAMING_COMPOSITOR = streaming_compositor()
+
+
+def compositor_from_config(cfg) -> Compositor:
+    """Resolve cfg.mpi.compositor ("dense" | "streaming") to the matching
+    unsharded Compositor; the plane-sharded twin is resolved by
+    parallel/data_parallel.py from the same knob. A numerics no-op
+    (PARITY.md): the two agree to fp-reassociation precision."""
+    name = cfg.mpi.compositor
+    if name == "dense":
+        return DENSE_COMPOSITOR
+    if name == "streaming":
+        return streaming_compositor(cfg.mpi.stream_chunk_planes)
+    raise ValueError(
+        f"mpi.compositor={name!r} must be 'dense' or 'streaming'"
+    )

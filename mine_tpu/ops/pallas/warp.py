@@ -36,6 +36,14 @@ saves them as residuals. Mosaic restrictions shaped all of this: in-tile
 `take_along_axis` only at native (8, 128) tiles, no nested dynamic-bound
 loops, no scalar div/mod by traced values, tile-aligned dynamic slice starts.
 
+On top of the banded forward sits `warp_composite_chw`, the fused
+warp-composite kernel of the streaming target compositor
+(ops/mpi_render.py): the plane axis rides the innermost (sequential) grid
+dimension, the over-composite accumulators stay resident in the output's
+VMEM block across the sweep, and each plane's source band is DMA'd through
+the same bbox walk the banded forward uses — one HBM pass for the whole
+S-plane sweep, with the warped plane values never leaving registers.
+
 Not used on CPU (Mosaic is TPU-only); tests run interpret mode on tiny shapes.
 """
 
@@ -51,6 +59,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 TILE_H = 8
 TILE_W = 128
+
+# renamed across pallas releases (TPUMemorySpace on jax 0.4.x)
+_ANY_MEMSPACE = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
 
 
 def _corner_gather4(tile: Array, ly0: Array, lx0: Array, accs) -> tuple:
@@ -92,9 +103,10 @@ def _corner_gather4(tile: Array, ly0: Array, lx0: Array, accs) -> tuple:
     )
 
 
-def _prep_coords(x_ref, y_ref, h: int, w: int):
+def _prep_coords(x: Array, y: Array, h: int, w: int):
     """Shared coordinate munging: border clamp, corner split, row-tile bbox.
 
+    x/y: (TILE_H, TILE_W) raw source-pixel coords of one output tile.
     Returns (wx, wy, x0, y0, r0, r1). The bbox covers the source ROW tiles
     the 4 corners can touch (y1 = y0+1), clamped to the real tile range: the
     coord block's padding lanes (edge output tiles) carry whatever was in
@@ -103,8 +115,8 @@ def _prep_coords(x_ref, y_ref, h: int, w: int):
     scalar div/mod by a traced count), and there are at most w/128 = 4
     column tiles.
     """
-    x = jnp.clip(x_ref[0], 0.0, w - 1.0)
-    y = jnp.clip(y_ref[0], 0.0, h - 1.0)
+    x = jnp.clip(x, 0.0, w - 1.0)
+    y = jnp.clip(y, 0.0, h - 1.0)
     x0f = jnp.floor(jnp.minimum(x, w - 2.0))
     y0f = jnp.floor(jnp.minimum(y, h - 2.0))
     wx = x - x0f
@@ -130,7 +142,7 @@ def _warp_kernel(x_ref, y_ref, src_ref, out_ref, *corner_refs,
     cotangent needs.
     """
     wp = src_ref.shape[3]
-    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref[0], y_ref[0], h, w)
 
     def visit(carry, r, cc):
         """Accumulate all 4 corners x all channels from source tile (r, cc).
@@ -238,7 +250,7 @@ def _warp_grad_kernel(x_ref, y_ref, g_ref, gsrc_ref, *,
         (i * TILE_H + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 0) < ho)
         & (j * TILE_W + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 1) < wo)
     )
-    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref[0], y_ref[0], h, w)
     # weights in the cotangent's dtype so bf16 cotangents stay bf16 all the
     # way to the store (and _scatter_tile's single-matmul bf16 path engages)
     wx = wx.astype(g_ref.dtype)
@@ -340,9 +352,11 @@ def _out_struct(shape, dtype, *operands):
     axes: under shard_map's strict vma checking, pallas_call outputs must
     declare how they vary across the mesh (they vary exactly as much as the
     inputs do — the kernel is pointwise in the mesh)."""
+    from mine_tpu.utils.jax_compat import typeof
+
     vma = frozenset()
     for op in operands:
-        vma |= getattr(jax.typeof(op), "vma", frozenset()) or frozenset()
+        vma |= getattr(typeof(op), "vma", frozenset()) or frozenset()
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -389,6 +403,46 @@ def _col_bbox(x0: Array, wp: int):
     return c0, c1
 
 
+def _gather_band_corners(dma_src, tile_ref, acc_ref, sem,
+                         x0, y0, r0, r1, wp: int, c: int) -> None:
+    """DMA every (row, col)-bbox source tile of one plane image and
+    accumulate the 4 bilinear corners of all c channels into acc_ref
+    (4, c, TILE_H, TILE_W), zeroed here. dma_src(start_r, start_c) -> the
+    (c, TILE_H, TILE_W) HBM ref to copy. One definition of the bbox/DMA
+    walk, shared by the banded forward and the fused warp-composite kernel.
+
+    Accumulators live in the VMEM scratch ref (not a fori carry) so each
+    bbox visit can be skipped wholesale with pl.when when its DMA would be
+    wasted — the footprint of a near-identity homography is 1-4 tiles, but
+    the static column walk covers wp/128 of them.
+    """
+    acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+    c0, c1 = _col_bbox(x0, wp)
+    n_col_tiles = wp // TILE_W
+
+    def row_body(r, carry):
+        start_r = pl.multiple_of(r * TILE_H, TILE_H)
+        ly0 = y0 - start_r
+        for cc in range(n_col_tiles):  # static walk; bbox gates the DMA
+            @pl.when(jnp.logical_and(cc >= c0, cc <= c1))
+            def _visit(cc=cc):
+                start_c = pl.multiple_of(cc * TILE_W, TILE_W)
+                cp = pltpu.make_async_copy(
+                    dma_src(start_r, start_c), tile_ref, sem
+                )
+                cp.start()
+                cp.wait()
+                lx0 = x0 - start_c
+                for ch in range(c):
+                    accs = tuple(acc_ref[k, ch] for k in range(4))
+                    new = _corner_gather4(tile_ref[ch], ly0, lx0, accs)
+                    for k in range(4):
+                        acc_ref[k, ch] = new[k]
+        return carry
+
+    lax.fori_loop(r0, r1 + 1, row_body, 0)
+
+
 def _warp_kernel_banded(x_ref, y_ref, src_hbm, out_ref, *rest,
                         h: int, w: int, c: int, save_corners: bool):
     """Beyond-VMEM forward: the source image stays in HBM (memory space ANY)
@@ -411,35 +465,11 @@ def _warp_kernel_banded(x_ref, y_ref, src_hbm, out_ref, *rest,
         corners_ref = None
     ni = pl.program_id(0)
     wp = src_hbm.shape[3]
-    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
-    c0, c1 = _col_bbox(x0, wp)
-
-    acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
-    n_col_tiles = wp // TILE_W
-
-    def row_body(r, carry):
-        start_r = pl.multiple_of(r * TILE_H, TILE_H)
-        ly0 = y0 - start_r
-        for cc in range(n_col_tiles):  # static walk; bbox gates the DMA
-            @pl.when(jnp.logical_and(cc >= c0, cc <= c1))
-            def _visit(cc=cc):
-                start_c = pl.multiple_of(cc * TILE_W, TILE_W)
-                cp = pltpu.make_async_copy(
-                    src_hbm.at[ni, :, pl.ds(start_r, TILE_H),
-                               pl.ds(start_c, TILE_W)],
-                    tile_ref, sem,
-                )
-                cp.start()
-                cp.wait()
-                lx0 = x0 - start_c
-                for ch in range(c):
-                    accs = tuple(acc_ref[k, ch] for k in range(4))
-                    new = _corner_gather4(tile_ref[ch], ly0, lx0, accs)
-                    for k in range(4):
-                        acc_ref[k, ch] = new[k]
-        return carry
-
-    lax.fori_loop(r0, r1 + 1, row_body, 0)
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref[0], y_ref[0], h, w)
+    _gather_band_corners(
+        lambda sr, sc: src_hbm.at[ni, :, pl.ds(sr, TILE_H), pl.ds(sc, TILE_W)],
+        tile_ref, acc_ref, sem, x0, y0, r0, r1, wp, c,
+    )
 
     wxc = wx.astype(out_ref.dtype)
     wyc = wy.astype(out_ref.dtype)
@@ -473,7 +503,7 @@ def _warp_grad_kernel_banded(x_ref, y_ref, g_ref, gsrc_init_hbm, gsrc_hbm,
         (i * TILE_H + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 0) < ho)
         & (j * TILE_W + lax.broadcasted_iota(jnp.int32, (TILE_H, TILE_W), 1) < wo)
     )
-    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref, y_ref, h, w)
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x_ref[0], y_ref[0], h, w)
     c0, c1 = _col_bbox(x0, wp)
     wx = wx.astype(g_ref.dtype)
     wy = wy.astype(g_ref.dtype)
@@ -538,7 +568,7 @@ def warp_bilinear_chw_banded(src: Array, coords_x: Array, coords_y: Array,
         kernel,
         grid=grid,
         in_specs=_coord_specs() + [
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_ANY_MEMSPACE.ANY),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -567,7 +597,9 @@ def warp_bilinear_grad_chw_banded(coords_x: Array, coords_y: Array, g: Array,
     # under shard_map the aliased output varies over the mesh exactly as the
     # cotangent does; the fresh zeros must be promoted to the same vma set
     # or the alias pairing trips strict vma checking
-    vma = getattr(jax.typeof(g), "vma", frozenset()) or frozenset()
+    from mine_tpu.utils.jax_compat import typeof
+
+    vma = getattr(typeof(g), "vma", frozenset()) or frozenset()
     if vma and hasattr(lax, "pvary"):
         gsrc_init = lax.pvary(gsrc_init, tuple(vma))
     out = pl.pallas_call(
@@ -575,9 +607,9 @@ def warp_bilinear_grad_chw_banded(coords_x: Array, coords_y: Array, g: Array,
         grid=grid,
         in_specs=_coord_specs() + [
             pl.BlockSpec((1, c, TILE_H, TILE_W), lambda ni, i, j: (ni, 0, i, j)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=_ANY_MEMSPACE.ANY),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        out_specs=pl.BlockSpec(memory_space=_ANY_MEMSPACE.ANY),
         out_shape=_out_struct((n, c, hp, wp), g.dtype, g, coords_x, coords_y),
         scratch_shapes=[
             pltpu.VMEM((c, TILE_H, TILE_W), g.dtype),
@@ -587,6 +619,123 @@ def warp_bilinear_grad_chw_banded(coords_x: Array, coords_y: Array, g: Array,
         interpret=interpret,
     )(coords_x, coords_y, g, gsrc_init)
     return out[:, :, :h, :w]
+
+
+def _warp_composite_kernel(x_ref, y_ref, dist_ref, z_ref, src_hbm, out_ref,
+                           tile_ref, corner_ref, sem, *,
+                           h: int, w: int, c: int):
+    """One (8, 128) output tile x one plane of the fused warp-composite
+    sweep. The plane axis is the INNERMOST grid dimension, so for a fixed
+    output tile the planes run sequentially and the out block (whose index
+    map ignores the plane) stays resident in VMEM — the over-composite
+    accumulates in place and is flushed to HBM once per output tile, after
+    the whole sweep. The source band of each plane is DMA'd through the
+    shared bbox walk; the warped plane values exist only as VPU registers.
+
+    x_ref/y_ref/dist_ref/z_ref: (1, 1, TILE_H, TILE_W) this plane's sample
+    coords, inter-plane distance, and target-frame z at this output tile.
+    src_hbm: (N, S, c, hp, wp) plane payload in HBM (rgb channels first,
+    sigma LAST). out_ref: (1, c+3, TILE_H, TILE_W) accumulators — rgb-
+    weighted sums (c-1), z-weighted sum, weight sum, in-FoV plane count,
+    running transmittance.
+    """
+    ni = pl.program_id(0)
+    s = pl.program_id(3)
+    wp = src_hbm.shape[4]
+    i_trans = c + 2  # transmittance accumulator channel
+
+    @pl.when(s == 0)
+    def _init():
+        out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
+        out_ref[0, i_trans] = jnp.ones((TILE_H, TILE_W), out_ref.dtype)
+
+    x = x_ref[0, 0]
+    y = y_ref[0, 0]
+    wx, wy, x0, y0, r0, r1 = _prep_coords(x, y, h, w)
+
+    _gather_band_corners(
+        lambda sr, sc: src_hbm.at[ni, s, :, pl.ds(sr, TILE_H),
+                                  pl.ds(sc, TILE_W)],
+        tile_ref, corner_ref, sem, x0, y0, r0, r1, wp, c,
+    )
+
+    wxc = wx.astype(out_ref.dtype)
+    wyc = wy.astype(out_ref.dtype)
+    vals = []
+    for ch in range(c):
+        a00, a01, a10, a11 = (corner_ref[k, ch] for k in range(4))
+        top = a00 * (1.0 - wxc) + a01 * wxc
+        bot = a10 * (1.0 - wxc) + a11 * wxc
+        vals.append(top * (1.0 - wyc) + bot * wyc)
+
+    z = z_ref[0, 0]
+    # planes behind the target camera contribute nothing (mpi_render.py
+    # warp_mpi_to_tgt); sigma rides last in the payload
+    sigma = jnp.where(z >= 0.0, vals[c - 1], 0.0)
+    # in-FoV validity, same open interval as homography_sample_coords
+    valid = (x > -1.0) & (x < float(w)) & (y > -1.0) & (y < float(h))
+    transparency = jnp.exp(-sigma * dist_ref[0, 0])
+    t_acc = out_ref[0, i_trans]
+    wgt = t_acc * (1.0 - transparency)
+    for ch in range(c - 1):
+        out_ref[0, ch] = out_ref[0, ch] + wgt * vals[ch]
+    out_ref[0, c - 1] = out_ref[0, c - 1] + wgt * z
+    out_ref[0, c] = out_ref[0, c] + wgt
+    out_ref[0, c + 1] = out_ref[0, c + 1] + valid.astype(out_ref.dtype)
+    # the 1e-6 eps matches the dense cumprod (mpi_render.py:82)
+    out_ref[0, i_trans] = t_acc * (transparency + 1.0e-6)
+
+
+def warp_composite_chw(src: Array, coords_x: Array, coords_y: Array,
+                       dist: Array, z: Array,
+                       interpret: bool = False) -> Array:
+    """Fused homography-warp + over-composite: the whole S-plane sweep in
+    one HBM pass per output tile.
+
+    src: (N, S, C, H, W) per-plane payload, rgb channels first, SIGMA LAST.
+    coords_x/coords_y/dist/z: (N, S, Ho, Wo) — per-plane source sample
+    coords, inter-plane distances (background pseudo-distance in the last
+    plane's slot), and target-frame plane z at the sample coords (behind-
+    camera masking + depth expectation).
+
+    Returns (N, C+3, Ho, Wo) float accumulators: rgb-weighted sums (C-1),
+    z-weighted sum, weight sum, in-FoV plane count, and the final
+    accumulated transmittance. Forward-only: the streaming compositor's
+    custom-vjp backward recomputes through the chunked scan
+    (ops/mpi_render.py _render_tgt_fused).
+    """
+    n, s, c, h, w = src.shape
+    _, _, ho, wo = coords_x.shape
+    hp, wp = padded_dims(h, w)
+    if hp != h or wp != w:
+        src = jnp.pad(src, ((0, 0), (0, 0), (0, 0), (0, hp - h), (0, wp - w)))
+    grid = (n, pl.cdiv(ho, TILE_H), pl.cdiv(wo, TILE_W), s)
+    kernel = functools.partial(_warp_composite_kernel, h=h, w=w, c=c)
+
+    def coord_spec():
+        return pl.BlockSpec(
+            (1, 1, TILE_H, TILE_W), lambda ni, i, j, sp: (ni, sp, i, j)
+        )
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[coord_spec(), coord_spec(), coord_spec(), coord_spec(),
+                  pl.BlockSpec(memory_space=_ANY_MEMSPACE.ANY)],
+        # accumulators: index map ignores the plane axis, so the block stays
+        # resident across the sweep and flushes once per output tile
+        out_specs=pl.BlockSpec(
+            (1, c + 3, TILE_H, TILE_W), lambda ni, i, j, sp: (ni, 0, i, j)
+        ),
+        out_shape=_out_struct((n, c + 3, ho, wo), src.dtype,
+                              src, coords_x, coords_y),
+        scratch_shapes=[
+            pltpu.VMEM((c, TILE_H, TILE_W), src.dtype),
+            pltpu.VMEM((4, c, TILE_H, TILE_W), src.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(coords_x, coords_y, dist, z, src)
 
 
 def warp_bilinear_grad_chw(coords_x: Array, coords_y: Array, g: Array,

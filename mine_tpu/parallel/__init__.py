@@ -22,6 +22,7 @@ from mine_tpu.parallel.plane_sharding import (
     sharded_render,
     sharded_render_src,
     sharded_render_tgt_rgb_depth,
+    sharded_render_tgt_streaming,
     sharded_weighted_sum_mpi,
     sharded_weighted_sum_src,
 )

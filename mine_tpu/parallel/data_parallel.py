@@ -15,11 +15,12 @@ from typing import Callable
 import jax
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from mine_tpu.utils.jax_compat import shard_map
 
 from mine_tpu.config import Config
 from mine_tpu.models import MPINetwork
-from mine_tpu.ops import DENSE_COMPOSITOR
+from mine_tpu.ops import compositor_from_config
 from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS
 from mine_tpu.parallel.plane_sharding import plane_compositor
 from mine_tpu.training.step import make_eval_step, make_train_step
@@ -42,10 +43,16 @@ def model_axes(mesh: Mesh) -> dict:
 
 
 def _plane_args(cfg: Config, mesh: Mesh) -> dict:
-    """plane_axis/compositor kwargs for make_{train,eval}_step, validated."""
+    """plane_axis/compositor kwargs for make_{train,eval}_step, validated.
+    cfg.mpi.compositor selects dense vs streaming in BOTH regimes: unsharded
+    it resolves through ops.compositor_from_config, plane-sharded the local
+    chunk-scan composes with the cross-device exclusive prefix
+    (plane_sharding.sharded_render_tgt_streaming)."""
     n_plane = mesh.shape.get(PLANE_AXIS, 1)
+    unsharded = compositor_from_config(cfg)  # unknown knob values fail loudly
+    streaming = cfg.mpi.compositor == "streaming"
     if n_plane <= 1:
-        return {"plane_axis": None, "compositor": DENSE_COMPOSITOR}
+        return {"plane_axis": None, "compositor": unsharded}
     if cfg.mpi.num_bins_coarse % n_plane:
         raise ValueError(
             f"mpi.num_bins_coarse={cfg.mpi.num_bins_coarse} must divide by "
@@ -58,7 +65,13 @@ def _plane_args(cfg: Config, mesh: Mesh) -> dict:
             f"mpi.num_bins_fine={cfg.mpi.num_bins_fine} must divide by "
             f"the plane-axis size {n_plane}"
         )
-    return {"plane_axis": PLANE_AXIS, "compositor": plane_compositor(PLANE_AXIS)}
+    return {
+        "plane_axis": PLANE_AXIS,
+        "compositor": plane_compositor(
+            PLANE_AXIS, streaming=streaming,
+            chunk_planes=cfg.mpi.stream_chunk_planes,
+        ),
+    }
 
 
 def make_parallel_train_step(

@@ -27,10 +27,36 @@ from jax import Array, lax
 from mine_tpu.ops.mpi_render import (
     _BG_DIST,
     Compositor,
+    DEFAULT_STREAM_CHUNK,
+    _chunk_size,
+    _finalize_depth,
     _shifted_exclusive,
+    _stream_scan,
     ray_norms,
     warp_mpi_to_tgt,
 )
+from mine_tpu.utils.jax_compat import axis_size, has_vma
+
+
+def _psum_replicated(x: Array, axis_name: str) -> Array:
+    """psum of per-device partial sums whose RESULT is consumed replicated
+    (every plane device computes the identical downstream loss graph).
+
+    On vma-tracking jax this is a plain psum: the replicated cotangent
+    transposes to the identity, so each device's partial receives exactly
+    its cotangent. On pre-vma jax (0.4.x shard_map) psum's transpose is
+    psum — the n identical consumer cotangents SUM, inflating every
+    gradient through the composite by the plane-axis size. Routing the
+    backward through the local summand only restores the exact gradient
+    (each logical consumer contributes once) while the forward still
+    returns the full replicated total; cross-device cotangent routes that
+    are REAL data dependencies (the all_gather prefix, the ppermute halo)
+    keep their ordinary collective transposes.
+    """
+    total = lax.psum(x, axis_name)
+    if has_vma():
+        return total
+    return x + lax.stop_gradient(total - x)
 
 
 def _exclusive_device_prefix(local_total: Array, axis_name: str) -> Array:
@@ -39,7 +65,7 @@ def _exclusive_device_prefix(local_total: Array, axis_name: str) -> Array:
     local_total: (...) this device's product over its local planes.
     Returns (...) product over all devices strictly before this one.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     gathered = lax.all_gather(local_total, axis_name)  # (n, ...)
     mask = (jnp.arange(n) < idx).reshape((n,) + (1,) * local_total.ndim)
@@ -59,14 +85,14 @@ def sharded_alpha_composition(
     prefix = _exclusive_device_prefix(trans_local[:, -1], axis_name)
     preserve = _shifted_exclusive(trans_local) * prefix[:, None]
     weights = alpha * preserve
-    composed = lax.psum(jnp.sum(value * weights, axis=1), axis_name)
+    composed = _psum_replicated(jnp.sum(value * weights, axis=1), axis_name)
     return composed, weights
 
 
 def _halo_next_first_plane(x: Array, axis_name: str, fill: Array) -> Array:
     """First plane of the NEXT device's chunk (for inter-plane distances).
     The last device receives `fill`. x: (B, S_local, ...) -> (B, ...)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     # shift first-plane slices one device towards lower plane indices
     recv = lax.ppermute(x[:, 0], axis_name, [(p, (p - 1) % n) for p in range(n)])
@@ -96,7 +122,7 @@ def sharded_plane_volume_rendering(
     # be replaced BEFORE the norm — d||v||/dv at v=0 is 0/0, and jnp.where
     # only masks the forward value, so a zero diff would send NaN cotangents
     # into xyz on the backward pass
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     is_last_device = lax.axis_index(axis_name) == n - 1
     s_local = diff.shape[1]
     last_mask = (jnp.arange(s_local) == s_local - 1).reshape(1, s_local, 1, 1, 1)
@@ -128,9 +154,11 @@ def sharded_weighted_sum_mpi(
 ) -> tuple[Array, Array]:
     """Plane-sharded expectation under compositing weights (unsharded twin:
     ops.weighted_sum_mpi)."""
-    weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
-    rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)
-    z_term = lax.psum(jnp.sum(weights * xyz[..., 2:3], axis=1), axis_name)
+    weights_sum = _psum_replicated(jnp.sum(weights, axis=1), axis_name)
+    rgb_out = _psum_replicated(jnp.sum(weights * rgb, axis=1), axis_name)
+    z_term = _psum_replicated(
+        jnp.sum(weights * xyz[..., 2:3], axis=1), axis_name
+    )
     if is_bg_depth_inf:
         depth_out = z_term + (1.0 - weights_sum) * 1000.0
     else:
@@ -197,7 +225,7 @@ def sharded_render_src(
     ddiff = jnp.abs(depth_ext[:, 1:] - depth_ext[:, :-1])  # (B, S_local)
 
     dist = ddiff[:, :, None, None, None] * ray_norms(k_inv, h, w)[:, None]
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     s_local = ddiff.shape[1]
     last_mask = (jnp.arange(s_local) == s_local - 1).reshape(1, s_local, 1, 1, 1)
     bg_mask = jnp.logical_and(lax.axis_index(axis_name) == n - 1, last_mask)
@@ -227,9 +255,9 @@ def sharded_weighted_sum_src(
     plane depth (unsharded twin: ops.weighted_sum_src — including its
     normalized-intrinsics assumption, K[2,2] = 1)."""
     z = (1.0 / mpi_disparity)[:, :, None, None, None]
-    weights_sum = lax.psum(jnp.sum(weights, axis=1), axis_name)
-    rgb_out = lax.psum(jnp.sum(weights * rgb, axis=1), axis_name)
-    z_term = lax.psum(jnp.sum(weights * z, axis=1), axis_name)
+    weights_sum = _psum_replicated(jnp.sum(weights, axis=1), axis_name)
+    rgb_out = _psum_replicated(jnp.sum(weights * rgb, axis=1), axis_name)
+    z_term = _psum_replicated(jnp.sum(weights * z, axis=1), axis_name)
     if is_bg_depth_inf:
         depth_out = z_term + (1.0 - weights_sum) * 1000.0
     else:
@@ -269,15 +297,71 @@ def sharded_render_tgt_rgb_depth(
     return tgt_rgb_syn, tgt_depth_syn, tgt_mask
 
 
-def plane_compositor(axis_name: str) -> Compositor:
+def sharded_render_tgt_streaming(
+    mpi_rgb_src: Array,
+    mpi_sigma_src: Array,
+    mpi_disparity_src: Array,
+    g_tgt_src: Array,
+    k_src_inv: Array,
+    k_tgt: Array,
+    axis_name: str,
+    use_alpha: bool = False,
+    is_bg_depth_inf: bool = False,
+    chunk_planes: int = DEFAULT_STREAM_CHUNK,
+) -> tuple[Array, Array, Array]:
+    """Plane-sharded STREAMING target render (unsharded twin:
+    ops.render_tgt_rgb_depth_streaming): each device chunk-scans its local
+    planes with initial transmittance 1 (ops/mpi_render._stream_scan), then
+    the existing cross-device exclusive prefix scales the partial sums —
+    the local scan composes with the prefix because every accumulator is
+    linear in the incoming transmittance.
+
+    Cross-ICI traffic stays statistics-only: one (B,) depth halo (ppermute
+    — the next device's first plane DEPTH; its xyz is analytic in it,
+    ops.plane_tgt_xyz), the (B, H, W, 1) transmittance all_gather, and the
+    psum'd (B, H, W, ·) partials. The (B, S_local, H, W, ·) slabs never
+    exist and never move.
+    """
+    n = axis_size(axis_name)
+    is_last = lax.axis_index(axis_name) == n - 1
+    depth = 1.0 / mpi_disparity_src  # (B, S_local)
+    halo = _halo_next_first_plane(
+        depth[:, :, None], axis_name, depth[:, -1:]
+    )[:, 0]  # (B,); fill unused (the background distance overwrites it)
+    chunk = _chunk_size(mpi_rgb_src.shape[1], chunk_planes)
+    rgb_p, z_p, w_p, m_p, t_total = _stream_scan(
+        mpi_rgb_src, mpi_sigma_src, mpi_disparity_src,
+        g_tgt_src, k_src_inv, k_tgt,
+        halo_depth=halo, bg_on_last=is_last, use_alpha=use_alpha, chunk=chunk,
+    )
+    prefix = _exclusive_device_prefix(t_total, axis_name)  # (B, H, W, 1)
+    rgb_out = _psum_replicated(prefix * rgb_p, axis_name)
+    z_sum = _psum_replicated(prefix * z_p, axis_name)
+    w_sum = _psum_replicated(prefix * w_p, axis_name)
+    mask = lax.psum(m_p, axis_name)[..., None]
+    depth_out = _finalize_depth(z_sum, w_sum, use_alpha, is_bg_depth_inf)
+    return rgb_out, depth_out, mask
+
+
+def plane_compositor(
+    axis_name: str,
+    streaming: bool = False,
+    chunk_planes: int = DEFAULT_STREAM_CHUNK,
+) -> Compositor:
     """The plane-sharded Compositor: drop-in for ops.DENSE_COMPOSITOR inside
     a shard_map whose `axis_name` carries the S-plane axis. Swapping this in
     is the whole difference between the unsharded and plane-parallel loss
-    graphs (training/step.py)."""
+    graphs (training/step.py). With `streaming` the target render chunk-scans
+    local planes (cfg.mpi.compositor, resolved by data_parallel._plane_args);
+    the source sweep keeps its per-plane weights either way (blending)."""
+    if streaming:
+        render_tgt = partial(_render_tgt_streaming_kw, axis_name, chunk_planes)
+    else:
+        render_tgt = partial(_render_tgt_kw, axis_name)
     return Compositor(
         render_src=partial(_render_src_kw, axis_name),
         weighted_sum_src=partial(_weighted_sum_src_kw, axis_name),
-        render_tgt_rgb_depth=partial(_render_tgt_kw, axis_name),
+        render_tgt_rgb_depth=render_tgt,
     )
 
 
@@ -304,4 +388,14 @@ def _render_tgt_kw(
     return sharded_render_tgt_rgb_depth(
         mpi_rgb, mpi_sigma, disparity, g, k_src_inv, k_tgt,
         axis_name, use_alpha, is_bg_depth_inf,
+    )
+
+
+def _render_tgt_streaming_kw(
+    axis_name, chunk_planes, mpi_rgb, mpi_sigma, disparity, g, k_src_inv,
+    k_tgt, use_alpha=False, is_bg_depth_inf=False,
+):
+    return sharded_render_tgt_streaming(
+        mpi_rgb, mpi_sigma, disparity, g, k_src_inv, k_tgt,
+        axis_name, use_alpha, is_bg_depth_inf, chunk_planes,
     )

@@ -71,6 +71,7 @@ class _Bucket:
         self.engine = engine
         self.cfg = engine.base_cfg.replace(**{
             "data.img_h": h, "data.img_w": w, "mpi.num_bins_coarse": s,
+            "mpi.compositor": engine.compositor,
         })
         self.is_c2f = self.cfg.mpi.num_bins_fine > 0
         self.num_planes = s + (self.cfg.mpi.num_bins_fine if self.is_c2f else 0)
@@ -169,11 +170,25 @@ class RenderEngine:
         metrics: Any | None = None,
         pose_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64),
         fov_deg: float = 90.0,
+        compositor: str = "streaming",
     ):
         import jax
 
+        from mine_tpu.ops import compositor_from_config
+
         enable_persistent_compile_cache()
         self.base_cfg = cfg
+        # Serving defaults to the STREAMING compositor regardless of the
+        # checkpoint's training-time knob: render-many never materializes
+        # the warped (N_poses, S, H, W, C) slabs, so the resident-MPI render
+        # batches (pose buckets) and plane counts can grow without moving
+        # the HBM watermark — and the knob is a numerics no-op (parity
+        # within 1e-5, tests/test_mpi_render.py; PARITY.md). Pass
+        # compositor="dense" to restore the materializing path.
+        self.compositor = compositor
+        compositor_from_config(
+            cfg.replace(**{"mpi.compositor": compositor})
+        )  # unknown names fail here, not inside a bucket compile
         # device_put ONCE: a checkpoint restored template-free
         # (training/checkpoint.py load_for_serving) arrives as host numpy
         # leaves, and numpy inputs to a compiled executable re-transfer on

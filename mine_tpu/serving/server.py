@@ -209,6 +209,14 @@ class ServingApp:
         self.batcher.stop()
 
 
+class _BodyTooLarge(Exception):
+    """Request body over _Handler.MAX_BODY_BYTES — mapped to HTTP 413."""
+
+    def __init__(self, length: int):
+        super().__init__(f"request body of {length} bytes exceeds the "
+                         f"{_Handler.MAX_BODY_BYTES}-byte limit")
+
+
 class _Handler(BaseHTTPRequestHandler):
     # one ThreadingHTTPServer thread per in-flight request; the shared app
     # object is thread-safe by construction (cache/batcher/engine locks)
@@ -231,8 +239,16 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(self, code: int, obj: dict) -> None:
         self._send(code, json.dumps(obj).encode(), "application/json")
 
+    # One request body must not be able to exhaust host RAM: the largest
+    # legitimate payload is a source image for /predict (a full-res PNG is
+    # a few MB; base64 inflates 4/3) or a /render pose list (KBs). Same
+    # client-cannot-grow-resources discipline as allowed_buckets.
+    MAX_BODY_BYTES = 64 * 1024 * 1024
+
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
+        if length > self.MAX_BODY_BYTES:
+            raise _BodyTooLarge(length)
         return self.rfile.read(length) if length else b""
 
     def _route(self, method: str, path: str) -> tuple[int, str]:
@@ -259,6 +275,13 @@ class _Handler(BaseHTTPRequestHandler):
             code, endpoint = self._route(method, path)
         except (BrokenPipeError, ConnectionResetError):
             raise
+        except _BodyTooLarge as exc:
+            # refuse WITHOUT reading: the oversized body is never buffered
+            code, endpoint = 413, path.lstrip("/") or "unknown"
+            try:
+                self._send_json(413, {"error": str(exc)})
+            except Exception:  # noqa: BLE001 - client already gone
+                pass
         except Exception as exc:  # noqa: BLE001 - HTTP boundary
             code, endpoint = 500, path.lstrip("/") or "unknown"
             try:

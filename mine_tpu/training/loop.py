@@ -218,7 +218,14 @@ class Trainer:
                     self.logger.info("profile trace written to %s/profile", self.local_dir)
 
                 if step_in_epoch % cfg.training.log_interval == 0:
-                    host_losses = {k: float(loss_dict[k]) for k in LOSS_KEYS}
+                    # one transfer for the whole dict: per-key float() would
+                    # block on a device sync PER KEY per log step
+                    host_losses = {
+                        k: float(v)
+                        for k, v in jax.device_get(
+                            {k: loss_dict[k] for k in LOSS_KEYS}
+                        ).items()
+                    }
                     for k, v in host_losses.items():
                         meters[k].update(v, cfg.training.log_interval)
                     lrs = learning_rates(cfg, steps_per_epoch, global_step)

@@ -45,6 +45,7 @@ from mine_tpu.losses import (
 )
 from mine_tpu.models import MPINetwork, predict_mpi_coarse_to_fine
 from mine_tpu.training.state import TrainState
+from mine_tpu.utils.jax_compat import axis_size, has_vma
 
 # datasets without metric COLMAP scale: disparity point losses are off and the
 # scale factor is 1 (synthesis_task.py:216-218, :312)
@@ -143,7 +144,7 @@ def forward_coarse_to_fine(
     disparity = make_disparity_list(cfg, key_disparity, b)
     disparity_full = disparity  # full-S list, identical on all plane devices
     if plane_axis is not None:
-        n_plane = lax.axis_size(plane_axis)
+        n_plane = axis_size(plane_axis)
         s_local = cfg.mpi.num_bins_coarse // n_plane
         start = lax.axis_index(plane_axis) * s_local
         disparity = lax.dynamic_slice_in_dim(disparity, start, s_local, axis=1)
@@ -183,7 +184,7 @@ def forward_coarse_to_fine(
         )
 
         assert key_fine is not None, "coarse-to-fine sampling needs a PRNG key"
-        n_plane = lax.axis_size(plane_axis)
+        n_plane = axis_size(plane_axis)
         # floor division + dynamic_slice clamping would otherwise render a
         # silently wrong plane subset for non-dividing counts (the
         # production path validates in parallel/data_parallel.py; direct
@@ -246,11 +247,18 @@ def render_novel_view(
     k_src_inv: Array,
     k_tgt: Array,
     scale_factor: Array | None = None,
-    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    compositor: ops.Compositor | None = None,
 ) -> dict[str, Array]:
     """Warp + composite the source MPI into the target camera
     (synthesis_task.py:455-494). scale_factor divides the pose translation
-    under stop_gradient (the reference's no_grad at :459-462)."""
+    under stop_gradient (the reference's no_grad at :459-462).
+
+    compositor defaults to the one cfg.mpi.compositor names
+    (ops.compositor_from_config) — "streaming" scans plane chunks instead of
+    materializing every warped plane; explicit callers (the plane-sharded
+    step) pass their mesh-aware twin."""
+    if compositor is None:
+        compositor = ops.compositor_from_config(cfg)
     if scale_factor is not None:
         sf = lax.stop_gradient(scale_factor)
         g_tgt_src = g_tgt_src.at[:, :3, 3].set(g_tgt_src[:, :3, 3] / sf[:, None])
@@ -289,7 +297,7 @@ def loss_fcn_per_scale(
     scale_factor: Array | None,
     is_val: bool,
     lpips_params: dict | None,
-    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    compositor: ops.Compositor | None = None,
     per_example: bool = False,
 ) -> tuple[dict[str, Array], dict[str, Array], Array]:
     """One scale of the supervision graph (synthesis_task.py:234-390).
@@ -309,6 +317,8 @@ def loss_fcn_per_scale(
 
     Returns (loss_dict, visualization_dict, scale_factor).
     """
+    if compositor is None:
+        compositor = ops.compositor_from_config(cfg)
     stride = 2**scale
     # nearest downsample == strided slice (reference nn.Upsample(size=…),
     # default nearest, synthesis_task.py:131-135: out[i] = in[i * 2^s])
@@ -464,7 +474,7 @@ def loss_fcn(
     lpips_params: dict | None = None,
     train: bool = True,
     plane_axis: str | None = None,
-    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    compositor: ops.Compositor | None = None,
     per_example: bool = False,
 ) -> tuple[Array, dict[str, Array], dict[str, Array], Any]:
     """Forward + all 4 scale losses + multi-scale aggregation
@@ -474,6 +484,8 @@ def loss_fcn(
     With `per_example` (eval only), loss_dict entries — including the
     aggregated "loss" — are (B,) vectors; see loss_fcn_per_scale.
     """
+    if compositor is None:
+        compositor = ops.compositor_from_config(cfg)
     key_disp, key_fine, key_dropout = jax.random.split(key, 3)
     if plane_axis is not None:
         # the disparity key MUST stay shared across plane devices (each
@@ -520,7 +532,7 @@ def make_train_step(
     tx: optax.GradientTransformation,
     axis_name: str | None = None,
     plane_axis: str | None = None,
-    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    compositor: ops.Compositor | None = None,
 ) -> Callable[[TrainState, dict[str, Array]], tuple[TrainState, dict[str, Array]]]:
     """Build the train-step function (one optimizer update,
     synthesis_task.py:627-635 under jit).
@@ -541,6 +553,8 @@ def make_train_step(
     them into the exact full-S gradient (a plane pmean would shrink it by
     the plane count).
     """
+    if compositor is None:
+        compositor = ops.compositor_from_config(cfg)
 
     def train_step(state: TrainState, batch: dict[str, Array]):
         rng = jax.random.fold_in(state.rng, state.step)
@@ -565,6 +579,20 @@ def make_train_step(
             return total, (loss_dict, new_stats)
 
         grads, (loss_dict, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
+        if not has_vma():
+            # Pre-vma shard_map (jax 0.4.x) has none of the
+            # replicated-cotangent machinery the docstring above describes:
+            # there each device's grad carries only its own shard's
+            # contribution, so the reduction is explicit — MEAN over the
+            # data axis (each replica grads its local-batch mean; this is
+            # the DDP allreduce) and SUM over the plane axis (each device
+            # owns its S_local planes' slice of the full-S gradient).
+            # On vma jax both reductions happen inside AD and these would
+            # double-count — hence the version gate.
+            if axis_name is not None:
+                grads = lax.pmean(grads, axis_name)
+            if plane_axis is not None:
+                grads = lax.psum(grads, plane_axis)
         if axis_name is not None:
             loss_dict = lax.pmean(loss_dict, axis_name)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
@@ -586,11 +614,13 @@ def make_eval_step(
     lpips_params: dict | None = None,
     axis_name: str | None = None,
     plane_axis: str | None = None,
-    compositor: ops.Compositor = ops.DENSE_COMPOSITOR,
+    compositor: ops.Compositor | None = None,
 ):
     """Eval step: same loss graph, eval-mode BN, no update
     (synthesis_task.py:496-527). Runs on every replica (the reference runs
     eval on rank 0 only — SURVEY.md §5.3 lists that as a gap, not a feature)."""
+    if compositor is None:
+        compositor = ops.compositor_from_config(cfg)
 
     def eval_step(state: TrainState, batch: dict[str, Array], key: Array):
         if axis_name is not None:

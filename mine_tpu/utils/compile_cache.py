@@ -16,8 +16,35 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> None:
 
     Safe to call on any jax version/backend: unknown config names are
     swallowed, matching the reference's attitude to optional accelerators.
+
+    A NO-OP when the process is pinned to CPU (JAX_PLATFORMS=cpu — tests,
+    the benches' degraded fallback, CPU CLIs): XLA:CPU's serialized-
+    executable round trip has been observed to reload a donated 8-device
+    shard_map train step as an executable that returns the params UNCHANGED
+    (all-zero updates, loss still correct) — first run after any HLO change
+    compiles fresh and is right, every warm-cache rerun silently wrong
+    (mine_tpu/utils/platform.py force_cpu_devices). The cache's payoff is
+    the TPU backend's multi-minute compiles; CPU keeps correctness.
     """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return
+
     import jax
+
+    try:
+        # env unset but a backend already committed: trust the real backend.
+        # (When no backend exists yet we deliberately do NOT initialize one
+        # here — on a hung TPU tunnel that first touch blocks forever, the
+        # exact failure every caller of this function routes around. An
+        # accelerator-less host with env unset and no backend yet therefore
+        # still enables the cache; every CPU entry point in this repo sets
+        # JAX_PLATFORMS=cpu, closing that path in practice.)
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends and jax.default_backend() == "cpu":
+            return
+    except Exception:  # pragma: no cover - private surface varies by version
+        pass
 
     if cache_dir is None:
         cache_dir = os.path.join(

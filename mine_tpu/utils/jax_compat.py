@@ -1,0 +1,58 @@
+"""Version-bridging imports for jax surfaces that moved between releases.
+
+The container pins jax 0.4.37 while parts of this repo were written against
+the promoted post-0.4 API; both must work:
+
+  - `shard_map` is `jax.shard_map` on new jax and
+    `jax.experimental.shard_map.shard_map` before the promotion. The bare
+    `from jax import shard_map` made the whole mine_tpu.parallel package —
+    and everything importing it (training loop, SPMD tests, tools) —
+    unimportable on 0.4.x.
+  - `jax.typeof` (the vma-carrying abstract-value probe the Pallas kernels
+    use under shard_map's strict vma checking) does not exist before the
+    vma concept itself; there the aval has no `vma` attribute, which the
+    callers already treat as "not varying over any mesh axis".
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map  # noqa: F401  (new jax)
+except ImportError:  # jax <= 0.4.x
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        # check_rep off by default: the plane-sharded compositor's
+        # pre-vma gradient correction (plane_sharding._psum_replicated)
+        # is replicated in VALUE but not statically inferable as such,
+        # and old check_rep rejects exactly that
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(*args, **kwargs)
+
+
+def typeof(x):
+    """jax.typeof where it exists; the plain abstract value otherwise (no
+    `vma` attribute there — callers default it to the empty set)."""
+    if hasattr(jax, "typeof"):
+        return jax.typeof(x)
+    return jax.core.get_aval(x)
+
+
+def has_vma() -> bool:
+    """Whether this jax tracks varying-manual-axes on avals at all."""
+    return hasattr(jax, "typeof")
+
+
+def axis_size(axis_name) -> int:
+    """lax.axis_size where it exists; the axis-frame lookup before it was
+    added. Both return the STATIC size of a named mesh axis from inside
+    shard_map — the callers build python-range chunk loops from it."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)  # a bare int on jax 0.4.x
+    return frame if isinstance(frame, int) else frame.size

@@ -15,13 +15,15 @@ compile-time over run-time, for correctness gates only, never benches.
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 
 from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
 
 
 def force_cpu_devices(
     n_devices: int,
-    compilation_cache: bool = True,
+    compilation_cache: bool = False,
     fast_compile: bool = False,
 ) -> None:
     """Force an n-device virtual CPU backend before any JAX backend touch.
@@ -30,6 +32,14 @@ def force_cpu_devices(
     XLA_FLAGS and jax_platforms are consumed at backend init and silently
     ignored afterwards); raises RuntimeError otherwise instead of letting
     the caller crash later on a confusing mesh-size error.
+
+    compilation_cache defaults OFF on the forced-CPU path: XLA:CPU's
+    persistent-cache round trip has been observed to DESERIALIZE a donated
+    8-device shard_map train step into an executable that returns the
+    params unchanged (all-zero updates, loss still correct) — first run
+    after any HLO change compiles fresh and is right, every warm-cache
+    rerun is silently wrong. TPU runs keep the cache (different, mature
+    serialization path; and the multi-minute compiles it exists for).
     """
     # Replace (not just append) any preset device-count flag: a preset value
     # != n_devices would win and make_mesh(n) would fail.
@@ -56,6 +66,72 @@ def force_cpu_devices(
             "JAX backend was already initialized in this process — force "
             "the platform in a fresh process."
         )
+
+
+def arm_watchdog(secs: int, emit_failure, label: str = "bench"):
+    """Run the caller's failure emitter and os._exit(1) unless the returned
+    Event is .set() within secs — the deadline discipline every bench entry
+    point shares (one definition, like resolve_backend_probe).
+
+    A THREAD, not SIGALRM: the guarded failure mode is a hang inside a
+    blocked C call (PJRT init over the dead tunnel), which never returns to
+    the interpreter to run a Python signal handler — but blocked syscalls
+    release the GIL, so a watchdog thread keeps running.
+    emit_failure(exc) must print the caller's one-line failure JSON.
+    """
+    import threading
+
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(secs):
+            emit_failure(
+                TimeoutError(f"{label} exceeded {secs}s (hung TPU tunnel?)")
+            )
+            sys.stdout.flush()
+            os._exit(1)
+
+    threading.Thread(
+        target=_watch, daemon=True, name=f"watchdog-{label}"
+    ).start()
+    return done
+
+
+def resolve_backend_probe(probe_timeout_s: int) -> str:
+    """Decide the backend BEFORE jax is touched in the calling process —
+    the shared policy of every bench entry point (bench.py,
+    tools/bench_serve.py, tools/bench_composite.py; one definition so a
+    probe fix cannot silently miss a bench).
+
+    JAX_PLATFORMS=cpu is honored as-is. Otherwise a subprocess — killable,
+    unlike an in-process hung PJRT init — probes the default backend; any
+    failure or timeout sets JAX_PLATFORMS=cpu in THIS process and returns a
+    degraded label with the reason, so the caller produces a labeled CPU
+    measurement instead of a null one. Call honor_jax_platforms() after.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu (JAX_PLATFORMS)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=probe_timeout_s,
+        )
+        platform = (
+            out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        )
+        if out.returncode == 0 and platform and platform != "cpu":
+            return platform  # accelerator reachable: use it
+        if out.returncode == 0 and platform == "cpu":
+            # a healthy host that simply has no accelerator is NOT the
+            # dead-tunnel failure mode — label it honestly
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            return "cpu (no accelerator)"
+        reason = f"probe rc={out.returncode} platform={platform!r}"
+    except subprocess.TimeoutExpired:
+        reason = f"probe hung > {probe_timeout_s}s (dead TPU tunnel?)"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return f"cpu (degraded: {reason})"
 
 
 def honor_jax_platforms() -> None:

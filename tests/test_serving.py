@@ -516,6 +516,20 @@ def test_serving_end_to_end_http(served_workspace):
         status, _ = _http(base, "/nope")
         assert status == 404
 
+        # a multi-GB Content-Length is refused (413) WITHOUT buffering the
+        # body — one request must not be able to exhaust host RAM
+        import http.client
+
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.putrequest("POST", "/predict")
+        conn.putheader("Content-Type", "image/png")
+        conn.putheader("Content-Length", str(8 * 1024 ** 3))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        assert "exceeds" in json.loads(resp.read())["error"]
+        conn.close()
+
         # predict singleflight: concurrent uploads of one NEW image share a
         # single encoder pass (the expensive-half analog of coalescing)
         png2 = _scene_png(phase=2.9)

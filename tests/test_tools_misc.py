@@ -158,6 +158,81 @@ def test_profile_summary_empty_dir(tmp_path):
     assert "error" in json.loads(out.stdout)
 
 
+# ------------------------------------------------- composite bench smoke
+
+
+def test_bench_composite_smoke_and_memory_delta():
+    """tools/bench_composite.py end to end at tiny sizes: exactly one JSON
+    line, schema fields present, and — the tier-1 gate — the STREAMING
+    compositor's compiled peak (XLA memory_analysis) strictly below the
+    dense one at every measured plane count, so the streaming path cannot
+    silently regress to materializing the warped planes."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # S >= 8: below that the scan's fixed carry overhead can exceed the
+    # (tiny) dense intermediates — the crossover the README documents
+    out = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bench_composite.py"),
+         "--sizes", "8,16", "--hw", "32x64", "--steps", "1"],
+        capture_output=True, text=True, env=env, timeout=420,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+    lines = out.stdout.strip().splitlines()
+    assert len(lines) == 1, lines
+    result = json.loads(lines[0])
+    assert result["metric"] == "mpi_composite_dense_over_stream_peak_bytes"
+    for key in ("value", "unit", "vs_baseline", "points", "backend", "note"):
+        assert key in result, key
+    assert result["value"] is not None and result["value"] > 1.0
+    by_key = {(p["mode"], p["s"]): p for p in result["points"]}
+    for s in result["sizes"]:
+        dense = by_key[("dense", s)]
+        stream = by_key[("streaming", s)]
+        assert stream["fwd_peak_bytes"] < dense["fwd_peak_bytes"], s
+        assert stream["grad_peak_bytes"] < dense["grad_peak_bytes"], s
+        for p in (dense, stream):
+            assert p["fwd_step_ms"] > 0 and p["modeled_moved_bytes"] > 0
+
+
+def test_bench_resolve_backend_honors_cpu(monkeypatch):
+    """bench.py's TPU probe must not spawn anything when CPU is already
+    requested — the degrade path is only for undecided backends."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    from mine_tpu.utils import platform as platform_mod
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    called = {"n": 0}
+    monkeypatch.setattr(
+        platform_mod.subprocess, "run",
+        lambda *a, **k: called.__setitem__("n", called["n"] + 1),
+    )
+    assert bench._resolve_backend() == "cpu (JAX_PLATFORMS)"
+    assert called["n"] == 0
+
+
+def test_bench_resolve_backend_degrades_on_hung_probe(monkeypatch):
+    """A hung TPU probe (the BENCH_r01-r05 failure) must degrade bench.py
+    to a labeled CPU run — JAX_PLATFORMS forced, reason recorded — instead
+    of leaving it to die at the watchdog with value: null."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import bench
+    from mine_tpu.utils import platform as platform_mod
+
+    # setenv (not delenv): the probe WRITES JAX_PLATFORMS on degrade, and
+    # monkeypatch must have recorded a prior state to restore afterwards
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+
+    def hung(*args, **kwargs):
+        raise subprocess.TimeoutExpired(cmd=args[0], timeout=1)
+
+    monkeypatch.setattr(platform_mod.subprocess, "run", hung)
+    note = bench._resolve_backend()
+    assert note.startswith("cpu (degraded:")
+    assert "hung" in note
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+
+
 # ------------------------------------------------- platform forcing guard
 
 

@@ -1,0 +1,261 @@
+#!/usr/bin/env python3
+"""Dense vs streaming target-view compositor: step time, peak live memory,
+and modeled bytes moved, at several plane counts.
+
+The streaming compositor (ops/mpi_render.py, `mpi.compositor: streaming`)
+exists to break the dense path's O(S·H·W) warped-intermediate ceiling —
+the reference's "memory consumption is huge, only one supervision is
+allowed" (synthesis_task.py:203-204). This bench makes that claim a
+measured artifact: for S in --sizes it compiles BOTH compositors' target
+renders (forward, and backward through a sum loss — the training shape)
+and reports
+
+  * step_ms            measured wall time per call (blocked on the result);
+  * peak_bytes         the compiled executable's own accounting
+                       (memory_analysis: temp + output buffers — exact and
+                       deterministic, works on the CPU backend);
+  * device_peak_bytes  jax device memory stats where the backend keeps them
+                       (TPU; None on CPU);
+  * modeled_moved_bytes the analytic bytes-through-HBM model documented at
+                       `modeled_moved_bytes` below.
+
+Backend policy (bench.py / tools/bench_serve.py contract): the TPU is
+probed in a killable subprocess; unreachable/hung => labeled CPU
+measurement, never `value: null`. Prints exactly one JSON line whose
+`value` is the dense/streaming peak-bytes ratio of the FORWARD render at
+the largest plane count (>1 means streaming peaks lower); tier-1 smokes it
+at tiny sizes and asserts the delta (tests/test_tools_misc.py).
+
+  python tools/bench_composite.py                     # S in {16, 32, 64}
+  python tools/bench_composite.py --sizes 4,8 --hw 32x64 --steps 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+METRIC = "mpi_composite_dense_over_stream_peak_bytes"
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_COMPOSITE_PROBE_TIMEOUT_S", "120"))
+RUN_TIMEOUT_S = int(os.environ.get("BENCH_COMPOSITE_RUN_TIMEOUT_S", "1500"))
+
+
+def _emit_failure(exc: BaseException) -> None:
+    print(json.dumps({
+        "metric": METRIC,
+        "value": None,
+        "unit": "x",
+        "vs_baseline": None,
+        "error": f"{type(exc).__name__}: {exc}"[:2000],
+        "note": "composite bench failed before producing a measurement",
+    }))
+
+
+def _arm_watchdog(secs: int):
+    """Emit the failure JSON and os._exit(1) unless .set() within secs
+    (the shared deadline discipline, mine_tpu/utils/platform.py)."""
+    from mine_tpu.utils.platform import arm_watchdog
+
+    return arm_watchdog(secs, _emit_failure)
+
+
+def _resolve_backend() -> str:
+    """Shared probe-or-degrade policy: a dead tunnel degrades to CPU
+    instead of hanging this process (mine_tpu/utils/platform.py)."""
+    from mine_tpu.utils.platform import resolve_backend_probe
+
+    return resolve_backend_probe(PROBE_TIMEOUT_S)
+
+
+def modeled_moved_bytes(mode: str, b: int, s: int, h: int, w: int,
+                        itemsize: int = 4) -> int:
+    """Analytic bytes-through-HBM per target render, counting only the
+    S-sized traffic (the composited outputs are O(H·W) in both modes).
+
+    dense: the warped rgb+sigma payload (4ch) is written then re-read; the
+    cumprod chain (transparency, accumulated transmittance, weights) is
+    three more written+read S-tensors; coords (2ch) and the analytic
+    dist/z (2ch) are read once.
+    streaming/fused: the source payload (4ch) and coords/dist/z (4ch) are
+    read once; accumulators stay resident.
+
+    A fusion-ideal XLA would beat the dense model and a cache would beat
+    the streaming one — this is a MODEL for cross-checking the measured
+    peaks, labeled as such in the JSON.
+    """
+    plane_px = b * s * h * w * itemsize
+    if mode == "dense":
+        return 2 * (4 * plane_px) + 2 * (3 * plane_px) + 4 * plane_px
+    return 4 * plane_px + 4 * plane_px
+
+
+def _peak_bytes(compiled) -> int | None:
+    """Peak live bytes from the executable's own memory accounting:
+    temp (scratch/intermediate) + output buffers. Deterministic, exact for
+    the compiled program, and available on the CPU backend — unlike
+    device.memory_stats, which CPU does not keep."""
+    try:
+        ma = compiled.memory_analysis()
+        return int(ma.temp_size_in_bytes + ma.output_size_in_bytes)
+    except Exception:  # pragma: no cover - backend-dependent surface
+        return None
+
+
+def _device_peak_bytes() -> int | None:
+    import jax
+
+    stats = jax.devices()[0].memory_stats()
+    if stats and "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    return None
+
+
+def _scene(b: int, s: int, h: int, w: int):
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mine_tpu.ops import inverse_3x3
+
+    rng = np.random.default_rng(0)
+    rgb = jnp.asarray(rng.uniform(size=(b, s, h, w, 3)).astype(np.float32))
+    sigma = jnp.asarray(
+        rng.uniform(0.1, 2.0, size=(b, s, h, w, 1)).astype(np.float32)
+    )
+    k = np.array(
+        [[0.8 * w, 0, w / 2], [0, 0.8 * w, h / 2], [0, 0, 1.0]], np.float32
+    )[None].repeat(b, 0)
+    k = jnp.asarray(k)
+    k_inv = inverse_3x3(k)
+    disparity = jnp.asarray(
+        np.linspace(1.0, 0.05, s, dtype=np.float32)
+    )[None].repeat(b, 0)
+    g = np.eye(4, dtype=np.float32)
+    g[:3, 3] = [0.05, -0.02, 0.01]
+    g = jnp.asarray(np.broadcast_to(g, (b, 4, 4)).copy())
+    return rgb, sigma, disparity, g, k_inv, k
+
+
+def _measure_mode(mode: str, args, s: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.ops import (
+        render_tgt_rgb_depth,
+        render_tgt_rgb_depth_streaming,
+    )
+
+    b, h, w = args.b, args.h, args.w
+    rgb, sigma, disparity, g, k_inv, k = _scene(b, s, h, w)
+    if mode == "dense":
+        render = render_tgt_rgb_depth
+    else:
+        def render(*a, **kw):
+            return render_tgt_rgb_depth_streaming(
+                *a, **kw, chunk_planes=args.chunk
+            )
+
+    operands = (rgb, sigma, disparity, g, k_inv, k)
+
+    def fwd(*ops_):
+        return render(*ops_)
+
+    def grad_loss(*ops_):
+        def loss(rgb_, sigma_):
+            ro, do, _ = render(rgb_, sigma_, *ops_[2:])
+            return jnp.sum(ro ** 2) + 0.1 * jnp.sum(do ** 2)
+
+        return jax.grad(loss, argnums=(0, 1))(ops_[0], ops_[1])
+
+    out = {"mode": mode, "s": s}
+    for name, fn in (("fwd", fwd), ("grad", grad_loss)):
+        compiled = jax.jit(fn).lower(*operands).compile()
+        res = compiled(*operands)
+        jax.block_until_ready(res)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            res = compiled(*operands)
+        jax.block_until_ready(res)
+        elapsed = (time.perf_counter() - t0) / args.steps
+        out[f"{name}_step_ms"] = round(elapsed * 1e3, 2)
+        out[f"{name}_peak_bytes"] = _peak_bytes(compiled)
+    out["device_peak_bytes"] = _device_peak_bytes()
+    out["modeled_moved_bytes"] = modeled_moved_bytes(mode, b, s, h, w)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--b", type=int, default=1, help="batch size")
+    ap.add_argument("--hw", default="128x128", help="HxW, e.g. 384x512")
+    ap.add_argument("--sizes", default="16,32,64",
+                    help="comma-separated plane counts")
+    ap.add_argument("--chunk", type=int, default=4,
+                    help="streaming scan chunk (mpi.stream_chunk_planes)")
+    ap.add_argument("--steps", type=int, default=5, help="timed calls/point")
+    args = ap.parse_args()
+    args.h, args.w = (int(v) for v in args.hw.lower().split("x"))
+    sizes = [int(v) for v in args.sizes.split(",") if v]
+
+    backend_note = _resolve_backend()
+
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+    run_ok = _arm_watchdog(RUN_TIMEOUT_S)
+
+    import jax
+
+    points = []
+    for s in sizes:
+        for mode in ("dense", "streaming"):
+            points.append(_measure_mode(mode, args, s))
+
+    s_top = sizes[-1]
+    by_key = {(p["mode"], p["s"]): p for p in points}
+    dense_peak = by_key[("dense", s_top)]["fwd_peak_bytes"]
+    stream_peak = by_key[("streaming", s_top)]["fwd_peak_bytes"]
+    ratio = (
+        round(dense_peak / stream_peak, 3)
+        if dense_peak and stream_peak else None
+    )
+
+    run_ok.set()
+    print(json.dumps({
+        "metric": METRIC,
+        "value": ratio,
+        "unit": "x",
+        "vs_baseline": None,
+        "b": args.b, "h": args.h, "w": args.w,
+        "sizes": sizes, "chunk": args.chunk,
+        "points": points,
+        "device": jax.devices()[0].device_kind,
+        "backend": backend_note,
+        "note": (
+            "value = dense/streaming peak live bytes (XLA memory_analysis: "
+            "temp+output) of the forward target render at the largest S; "
+            ">1 means the streaming compositor peaks lower. step_ms is "
+            "measured; modeled_moved_bytes is the analytic HBM-traffic "
+            "model documented in modeled_moved_bytes(), not a measurement"
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 - emit-then-reraise contract
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_failure(exc)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)

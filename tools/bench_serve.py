@@ -26,7 +26,6 @@ import argparse
 import io
 import json
 import os
-import subprocess
 import sys
 import threading
 import time
@@ -52,43 +51,19 @@ def _emit_failure(exc: BaseException) -> None:
 
 
 def _arm_watchdog(secs: int):
-    """Print the failure JSON and hard-exit unless .set() within secs
-    (bench.py's watchdog pattern: a blocked C call never sees SIGALRM)."""
-    done = threading.Event()
+    """Emit the failure JSON and os._exit(1) unless .set() within secs
+    (the shared deadline discipline, mine_tpu/utils/platform.py)."""
+    from mine_tpu.utils.platform import arm_watchdog
 
-    def _watch():
-        if not done.wait(secs):
-            _emit_failure(TimeoutError(f"bench exceeded {secs}s"))
-            sys.stdout.flush()
-            os._exit(1)
-
-    threading.Thread(target=_watch, daemon=True, name="watchdog").start()
-    return done
+    return arm_watchdog(secs, _emit_failure)
 
 
 def _resolve_backend() -> str:
-    """Decide the backend BEFORE touching jax in this process.
+    """Shared probe-or-degrade policy: decide the backend BEFORE touching
+    jax in this process (mine_tpu/utils/platform.py)."""
+    from mine_tpu.utils.platform import resolve_backend_probe
 
-    JAX_PLATFORMS=cpu is honored as-is. Otherwise a subprocess (killable,
-    unlike an in-process hung PJRT init) probes the default backend; any
-    failure or timeout degrades this process to CPU.
-    """
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return "cpu (JAX_PLATFORMS)"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
-        platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-        if out.returncode == 0 and platform and platform != "cpu":
-            return platform  # accelerator reachable: use it
-        reason = f"probe rc={out.returncode} platform={platform!r}"
-    except subprocess.TimeoutExpired:
-        reason = f"probe hung > {PROBE_TIMEOUT_S}s (dead TPU tunnel?)"
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    return f"cpu (degraded: {reason})"
+    return resolve_backend_probe(PROBE_TIMEOUT_S)
 
 
 def _http(base: str, path: str, data=None, headers=None, timeout=600):
