@@ -23,10 +23,12 @@ records why) instead of dying at the watchdog with nothing.
 Prints exactly one JSON line:
   {"metric", "value", "unit", "vs_baseline", "flops_per_step",
    "model_tflops_per_sec", "mfu", "step_ms", "mosaic_kernel_calls",
-   "width_multiple", "device", "backend", "note"} plus *_b8 twins for the
-  optional second point; on failure {"metric", "value": null, "error",
-  "note"} — reachable now only by a genuine in-run crash, not by the
-  tunnel being dead.
+   "width_multiple", "device", "backend", "obs", "note"} plus *_b8 twins
+  for the optional second point; on failure {"metric", "value": null,
+  "error", "obs", "note"} — reachable now only by a genuine in-run crash,
+  not by the tunnel being dead. The "obs" payload (mine_tpu/obs/) is the
+  phase breakdown + platform-probe verdict, present on success AND
+  failure, so a degraded round still carries diagnostics.
 """
 
 from __future__ import annotations
@@ -35,6 +37,17 @@ import json
 import os
 import sys
 import time
+
+# stdlib-only imports (mine_tpu.obs never touches jax at import time): the
+# peak-FLOPs table moved to the observability subsystem so bench, training,
+# and serving all divide by the same published numbers, and the bench's own
+# phases are recorded as host spans so even a FAILED round carries a
+# phase-breakdown diagnostic payload instead of a bare rc=1
+from mine_tpu.obs.cost import chip_peak_flops, compiled_cost
+from mine_tpu.obs.trace import Tracer
+
+_TRACER = Tracer(enabled=True, max_spans=2048)
+_BACKEND_NOTE: str | None = None
 
 BATCH = 2
 WARMUP_STEPS = 3
@@ -63,47 +76,21 @@ def _arm_watchdog(secs: int, what: str):
 
     return arm_watchdog(secs, _emit_failure, what)
 
-# Published dense bf16 peak FLOP/s PER JAX DEVICE (what the executable and
-# its cost analysis run on). On v2/v3 a jax device is one core (half a chip:
-# 45/123 TFLOP per chip => 22.5/61.5 per core); v4 onward exposes one
-# megacore device per chip. Sources: Google Cloud TPU docs / "How to Scale
-# Your Model"; keyed by jax device_kind.
-_CHIP_PEAK_FLOPS = {
-    "TPU v2": 22.5e12,
-    "TPU v3": 61.5e12,
-    "TPU v4": 275e12,
-    "TPU v4 lite": 137e12,  # v4i
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,       # v5p (kept after the longer v5-lite/v5e keys)
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-    "TPU v6e": 918e12,
-    "TPU7x": 2307e12,       # ironwood, fp8-capable; bf16 peak
-}
-
-
-def chip_peak_flops(device_kind: str) -> float | None:
-    """Peak FLOP/s of one jax device of this kind (None when unknown)."""
-    if device_kind in _CHIP_PEAK_FLOPS:
-        return _CHIP_PEAK_FLOPS[device_kind]
-    # prefix match tolerates suffixes like "TPU v4 (podslice)"
-    for kind, peak in sorted(_CHIP_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if device_kind.startswith(kind):
-            return peak
-    return None
-
-
 def executable_flops(compiled) -> float | None:
-    """FLOPs of one step from XLA's own cost analysis of the executable."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):  # some backends wrap in a list
-            cost = cost[0]
-        flops = cost.get("flops")
-        return float(flops) if flops and flops > 0 else None
-    except Exception:  # pragma: no cover - backend-dependent surface
-        return None
+    """FLOPs of one step from XLA's own cost analysis of the executable
+    (mine_tpu/obs/cost.py owns the extraction and the peak tables)."""
+    return compiled_cost(compiled).flops
+
+
+def _obs_snapshot() -> dict:
+    """The diagnostic payload every emitted JSON carries (success OR
+    failure): which bench phases ran and for how long, plus the platform
+    probe's verdict — so a dead round still says WHERE it died."""
+    return {
+        "platform_probe": _BACKEND_NOTE,
+        "phases": _TRACER.phase_summary(),
+        "dropped_spans": _TRACER.dropped,
+    }
 
 
 def mosaic_kernel_calls(compiled) -> int | None:
@@ -133,7 +120,10 @@ def _resolve_backend() -> str:
 
 
 def main() -> None:
-    backend_note = _resolve_backend()
+    global _BACKEND_NOTE
+    with _TRACER.span("resolve_backend", cat="bench"):
+        backend_note = _resolve_backend()
+    _BACKEND_NOTE = backend_note
     on_cpu = backend_note.startswith("cpu")
     if on_cpu:
         # make JAX_PLATFORMS=cpu stick even against self-registering
@@ -149,7 +139,8 @@ def main() -> None:
     enable_persistent_compile_cache()
 
     init_ok = _arm_watchdog(INIT_TIMEOUT_S, "TPU backend init")
-    jax.devices()
+    with _TRACER.span("backend_init", cat="bench"):
+        jax.devices()
     init_ok.set()
     run_ok = _arm_watchdog(RUN_TIMEOUT_S, "benchmark run")
     _run(backend_note, on_cpu)
@@ -214,10 +205,12 @@ def _measure_point(
         return float(loss_dict["loss"]) + float(jnp.sum(leaf))
 
     def compile_and_warm(state, step):
-        compiled = step.lower(state, batch).compile()
-        for _ in range(warmup_steps):
-            state, loss_dict = compiled(state, batch)
-        force(state, loss_dict)
+        with _TRACER.span("compile", cat="bench", batch=batch_size):
+            compiled = step.lower(state, batch).compile()
+        with _TRACER.span("warmup", cat="bench", batch=batch_size):
+            for _ in range(warmup_steps):
+                state, loss_dict = compiled(state, batch)
+            force(state, loss_dict)
         return compiled, state, loss_dict
 
     remat_used = False
@@ -244,9 +237,11 @@ def _measure_point(
         print(f"# profile trace written to {profile_dir}", file=sys.stderr)
 
     t0 = time.perf_counter()
-    for _ in range(measure_steps):
-        state, loss_dict = compiled(state, batch)
-    force(state, loss_dict)
+    with _TRACER.span("measure", cat="bench", batch=batch_size,
+                      steps=measure_steps):
+        for _ in range(measure_steps):
+            state, loss_dict = compiled(state, batch)
+        force(state, loss_dict)
     elapsed = time.perf_counter() - t0
 
     imgs_per_sec = batch_size * measure_steps / elapsed
@@ -299,6 +294,7 @@ def _run(backend_note: str = "", on_cpu: bool = False) -> None:
         "width_multiple": primary["width_multiple"],
         "device": primary["device"],
         "backend": backend_note,
+        "obs": _obs_snapshot(),
         "note": (
             "vs_baseline awaits a reference denominator on comparable "
             "hardware (the reference repo publishes no throughput, SURVEY.md "
@@ -344,7 +340,10 @@ def _emit_failure(exc: BaseException) -> None:
     a valid number must never be discarded."""
     msg = f"{type(exc).__name__}: {exc}"
     if _RESULT_SO_FAR is not None:
-        print(json.dumps({**_RESULT_SO_FAR, "late_error": msg[:2000]}))
+        print(json.dumps({
+            **_RESULT_SO_FAR, "late_error": msg[:2000],
+            "obs": _obs_snapshot(),
+        }))
         return
     print(json.dumps({
         "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
@@ -352,7 +351,9 @@ def _emit_failure(exc: BaseException) -> None:
         "unit": "imgs/sec",
         "vs_baseline": None,
         "error": msg[:2000],
-        "note": "benchmark failed before producing a measurement; see error",
+        "obs": _obs_snapshot(),
+        "note": "benchmark failed before producing a measurement; the obs "
+                "payload records which phase died and the probe verdict",
     }))
 
 

@@ -13,7 +13,8 @@ undefined-key read `mpi.render_tgt_rgb_depth` (silently aliasing
 `mpi.is_bg_depth_inf`, synthesis_task.py:279) does not exist — there is one
 key, `mpi.is_bg_depth_inf`, used everywhere the reference meant it.
 
-New TPU-native keys live under `mesh.*` (device mesh layout) and a few
+New TPU-native keys live under `mesh.*` (device mesh layout), `obs.*`
+(observability: tracing, flight recorder, MFU accounting) and a few
 `training.*`/`model.*` additions (dtype, remat, weight paths); defaults in
 mine_tpu/configs/default.yaml.
 """
@@ -129,6 +130,34 @@ class TrainingConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs (mine_tpu/obs/; no reference analog). Everything
+    defaults OFF: the disabled tracer is a no-op context manager, so the
+    instrumented hot paths cost nothing until a run opts in."""
+
+    # master switch: host-span tracing + per-step phase breakdown + the
+    # flight recorder's signal handlers
+    enabled: bool = False
+    # bounded span ring (oldest spans drop; the drop count is exported)
+    trace_buffer_spans: int = 4096
+    # jax.profiler device-trace window: start `profile_start_offset` steps
+    # after (re)start, run for `profile_steps` steps (0 = no device trace).
+    # Replaces the loop's old hardcoded 5-step window.
+    profile_start_offset: int = 5
+    profile_steps: int = 0
+    # stall watchdog: no completed step for this many seconds => flight
+    # dump (thread stacks + last-K spans + device memory). 0 disables.
+    flight_watchdog_s: float = 0.0
+    flight_last_k_spans: int = 256
+    # per-compiled-step cost_analysis + the MFU / achieved-bandwidth gauges
+    cost_enabled: bool = True
+    # peak FLOP/s the MFU gauge divides by when the device kind has no
+    # published table entry (the only honest option on CPU meshes); 0 =
+    # use the per-platform table in obs/cost.py
+    peak_flops_override: float = 0.0
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout (TPU-native; no reference analog — the reference's
     only axis is NCCL data-parallel process count, train.py:66)."""
@@ -146,6 +175,7 @@ class Config:
     loss: LossConfig = field(default_factory=LossConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     def replace(self, **dot_key_values: Any) -> "Config":
         """Functional update by dot-keys: cfg.replace(**{"mpi.num_bins_coarse": 8})."""

@@ -1,0 +1,41 @@
+"""Observability: host-span tracing, flight recorder, cost/MFU accounting.
+
+Three pillars (no reference analog — the reference logs loss lines and
+nothing else; VERDICT r5 records five consecutive benchmark rounds that
+died with zero diagnostics):
+
+  * obs/trace.py  — lightweight host-side spans with Chrome-trace JSON
+    export that merges with the device traces jax.profiler writes.
+  * obs/flight.py — flight recorder: signal + stall-watchdog dump of
+    all-thread stacks, the last-K spans, and device memory stats.
+  * obs/cost.py   — per-compiled-step FLOPs/bytes from XLA's own cost
+    analysis, a per-platform peak table, and MFU / achieved-bandwidth
+    arithmetic.
+
+Everything is stdlib + jax-optional: the tracer and flight recorder never
+import jax at module level, so they work in data-loader processes too.
+"""
+
+from mine_tpu.obs.cost import (
+    StepCost,
+    achieved_fraction,
+    chip_peak_flops,
+    chip_peak_hbm_bytes,
+    compiled_cost,
+    compute_mfu,
+)
+from mine_tpu.obs.flight import FlightRecorder
+from mine_tpu.obs.trace import NULL_TRACER, Span, Tracer
+
+__all__ = [
+    "FlightRecorder",
+    "NULL_TRACER",
+    "Span",
+    "StepCost",
+    "Tracer",
+    "achieved_fraction",
+    "chip_peak_flops",
+    "chip_peak_hbm_bytes",
+    "compiled_cost",
+    "compute_mfu",
+]

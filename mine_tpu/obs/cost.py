@@ -1,0 +1,170 @@
+"""Cost accounting: FLOPs/bytes per compiled step, peaks, MFU.
+
+The "move MFU off 5.0%" roadmap item needs an MFU *instrument*, not a
+bench artifact: XLA's own cost analysis of the compiled executable
+(`lowered.compile().cost_analysis()`) gives the FLOPs and bytes the step
+actually runs, `memory_analysis()` gives its peak live bytes, and the
+published per-device peak tables turn a measured step time into MFU and
+achieved-bandwidth fractions. bench.py, the training loop, and the
+serving engine all quote THIS module, so every number in a BENCH_*.json,
+a metrics.jsonl line, and a /metrics gauge shares one definition.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from typing import Any
+
+# Published dense bf16 peak FLOP/s PER JAX DEVICE (what the executable and
+# its cost analysis run on). On v2/v3 a jax device is one core (half a chip:
+# 45/123 TFLOP per chip => 22.5/61.5 per core); v4 onward exposes one
+# megacore device per chip. Sources: Google Cloud TPU docs / "How to Scale
+# Your Model"; keyed by jax device_kind.
+CHIP_PEAK_FLOPS = {
+    "TPU v2": 22.5e12,
+    "TPU v3": 61.5e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 137e12,  # v4i
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,       # v5p (kept after the longer v5-lite/v5e keys)
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e / Trillium
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,       # ironwood, fp8-capable; bf16 peak
+}
+
+# Published HBM bandwidth, bytes/s per jax device (same per-core halving on
+# v2/v3). Same sources as the FLOPs table.
+CHIP_PEAK_HBM_BYTES = {
+    "TPU v2": 350e9,
+    "TPU v3": 450e9,
+    "TPU v4": 1228e9,
+    "TPU v4 lite": 614e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+    "TPU7x": 7400e9,
+}
+
+
+def _lookup(table: dict[str, float], device_kind: str) -> float | None:
+    if device_kind in table:
+        return table[device_kind]
+    # prefix match tolerates suffixes like "TPU v4 (podslice)"
+    for kind, peak in sorted(table.items(), key=lambda kv: -len(kv[0])):
+        if device_kind.startswith(kind):
+            return peak
+    return None
+
+
+def chip_peak_flops(device_kind: str) -> float | None:
+    """Peak FLOP/s of one jax device of this kind (None when unknown —
+    notably "cpu": no honest published number exists for an arbitrary
+    host, so CPU runs pass an explicit obs.peak_flops_override instead
+    of trusting a made-up table entry)."""
+    return _lookup(CHIP_PEAK_FLOPS, device_kind)
+
+
+def chip_peak_hbm_bytes(device_kind: str) -> float | None:
+    """Peak memory bandwidth (bytes/s) of one jax device (None unknown)."""
+    return _lookup(CHIP_PEAK_HBM_BYTES, device_kind)
+
+
+@dataclass(frozen=True)
+class StepCost:
+    """What one invocation of a compiled executable costs, per XLA."""
+
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    peak_memory_bytes: float | None = None   # temp + output live bytes
+    argument_bytes: float | None = None
+    output_bytes: float | None = None
+
+    def to_dict(self) -> dict[str, float | None]:
+        return asdict(self)
+
+
+def compiled_cost(compiled: Any) -> StepCost:
+    """Extract FLOPs/bytes from a jax Compiled (lowered.compile() result).
+
+    Every probe is individually guarded: backends differ in which analyses
+    they implement (and the tunneled TPU backend can fail mid-call) — a
+    partial StepCost beats an exception in an instrument.
+    """
+    flops = bytes_accessed = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # some backends wrap in a list
+            cost = cost[0]
+        if cost:
+            f = cost.get("flops")
+            flops = float(f) if f and f > 0 else None
+            b = cost.get("bytes accessed")
+            bytes_accessed = float(b) if b and b > 0 else None
+    except Exception:  # noqa: BLE001 - backend-dependent surface
+        pass
+    peak = arg_b = out_b = None
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            peak = float(ma.temp_size_in_bytes + ma.output_size_in_bytes)
+            arg_b = float(ma.argument_size_in_bytes)
+            out_b = float(ma.output_size_in_bytes)
+    except Exception:  # noqa: BLE001
+        pass
+    return StepCost(
+        flops=flops, bytes_accessed=bytes_accessed, peak_memory_bytes=peak,
+        argument_bytes=arg_b, output_bytes=out_b,
+    )
+
+
+def compute_mfu(
+    flops_per_step: float | None,
+    step_seconds: float,
+    peak_flops: float | None,
+) -> float | None:
+    """Model FLOPs utilization: achieved FLOP/s over the device peak.
+
+    None in, None out — an unknown FLOP count or peak must surface as an
+    absent gauge, never as a fake 0% or 100%.
+    """
+    if not flops_per_step or not peak_flops or step_seconds <= 0:
+        return None
+    return (flops_per_step / step_seconds) / peak_flops
+
+
+def achieved_fraction(
+    amount_per_step: float | None,
+    step_seconds: float,
+    peak_per_second: float | None,
+) -> float | None:
+    """Generic achieved/peak fraction (bytes for bandwidth, FLOPs for MFU)."""
+    if not amount_per_step or not peak_per_second or step_seconds <= 0:
+        return None
+    return (amount_per_step / step_seconds) / peak_per_second
+
+
+def resolve_peak_flops(device: Any = None, override: float = 0.0) -> float | None:
+    """The peak the gauges divide by: an explicit override wins (the only
+    honest option on CPU meshes); else the per-kind table; else None."""
+    if override and override > 0:
+        return float(override)
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    return chip_peak_flops(device.device_kind)
+
+
+def resolve_peak_hbm_bytes(device: Any = None, override: float = 0.0) -> float | None:
+    if override and override > 0:
+        return float(override)
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    return chip_peak_hbm_bytes(device.device_kind)

@@ -1,0 +1,277 @@
+"""Host-side span tracing with Chrome-trace JSON export.
+
+jax.profiler captures what the DEVICE does; nothing in this repo captured
+what the HOST does around it — data staging, dispatch, device_get syncs,
+checkpoint writes, HTTP request phases. The tracer fills that half:
+
+  * `Tracer.span(name)` is a context manager recording a wall-clock span
+    into a bounded ring buffer (deque), with a thread-local stack so spans
+    nest and a per-(cat, name) running total for cheap phase summaries.
+  * `to_chrome_trace()` / `export()` emit Chrome trace-event JSON whose
+    process lane is named `mine_tpu host spans`, so the file drops into
+    chrome://tracing / Perfetto NEXT TO a `jax.profiler` device trace and
+    tools/profile_summary.py can print one merged host+device table.
+  * Disabled (the default everywhere but serving), `span()` returns a
+    shared no-op context manager — one attribute check and no allocation,
+    so leaving the instrumentation in hot paths costs nothing measurable
+    (guarded by a tier-1 smoke in tests/test_obs.py).
+
+Thread-safety: the ring and totals take a reentrant lock (reentrant so a
+signal-handler flight dump on the main thread can snapshot the ring even
+if it interrupted an append); the span stack is thread-local.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+# the process-lane name host exports carry; tools/profile_summary.py keys
+# its host-vs-device lane split on this string
+HOST_PROCESS_NAME = "mine_tpu host spans"
+
+
+@dataclass(frozen=True)
+class Span:
+    """One completed span. Times are microseconds on the tracer's
+    monotonic epoch (perf_counter-based — durations are exact; absolute
+    alignment with a device trace is not promised, same as any two
+    independent trace clocks)."""
+
+    name: str
+    cat: str
+    ts_us: float
+    dur_us: float
+    tid: int
+    thread_name: str
+    depth: int
+    args: dict[str, Any] = field(default_factory=dict)
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """Context manager for one enabled span."""
+
+    __slots__ = ("tracer", "name", "cat", "args", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.t0 = 0.0
+
+    def __enter__(self) -> "_LiveSpan":
+        self.tracer._push(self.name)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        t1 = time.perf_counter()
+        self.tracer._pop_and_record(
+            self.name, self.cat, self.t0, t1, self.args
+        )
+
+
+class Tracer:
+    """Bounded-ring host span recorder; one per subsystem instance.
+
+    on_span: optional callback invoked (outside the lock) with each
+    completed Span — the serving stack hooks its trace-counter metric
+    family here.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        max_spans: int = 4096,
+        on_span: Callable[[Span], None] | None = None,
+    ):
+        self.enabled = bool(enabled)
+        self.max_spans = int(max_spans)
+        self.on_span = on_span
+        self._epoch = time.perf_counter()
+        self._lock = threading.RLock()
+        self._spans: deque[Span] = deque(maxlen=self.max_spans)
+        self._dropped = 0
+        # running (cat, name) -> [count, total_us] since last summary reset
+        self._totals: dict[tuple[str, str], list[float]] = defaultdict(
+            lambda: [0.0, 0.0]
+        )
+        self._local = threading.local()
+
+    # -- recording -----------------------------------------------------------
+
+    def span(self, name: str, cat: str = "host", **args: Any):
+        """Context manager timing one phase; no-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, cat, args)
+
+    def _stack(self) -> list[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def _pop_and_record(
+        self, name: str, cat: str, t0: float, t1: float, args: dict
+    ) -> None:
+        stack = self._stack()
+        depth = max(len(stack) - 1, 0)
+        if stack and stack[-1] == name:
+            stack.pop()
+        self._record(name, cat, t0, t1, args, depth)
+
+    def _record(
+        self, name: str, cat: str, t0: float, t1: float, args: dict,
+        depth: int,
+    ) -> None:
+        thread = threading.current_thread()
+        span = Span(
+            name=name,
+            cat=cat,
+            ts_us=(t0 - self._epoch) * 1e6,
+            dur_us=(t1 - t0) * 1e6,
+            tid=thread.ident or 0,
+            thread_name=thread.name,
+            depth=depth,
+            args=args,
+        )
+        with self._lock:
+            if len(self._spans) == self.max_spans:
+                self._dropped += 1
+            self._spans.append(span)
+            tot = self._totals[(cat, name)]
+            tot[0] += 1
+            tot[1] += span.dur_us
+        if self.on_span is not None:
+            self.on_span(span)
+
+    def record(
+        self, name: str, cat: str, t0: float, t1: float, **args: Any
+    ) -> None:
+        """Record a span from explicit perf_counter endpoints — for phases
+        whose start and end live in different stack frames (e.g. the
+        batcher's queue-wait, measured from another request's enqueue).
+        Never touches the thread-local span stack."""
+        if not self.enabled:
+            return
+        self._record(name, cat, t0, t1, args, depth=0)
+
+    def active_spans(self) -> list[str]:
+        """This thread's currently-open span names (outer -> inner)."""
+        return list(self._stack())
+
+    # -- reading -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def snapshot(self, last_k: int | None = None) -> list[Span]:
+        with self._lock:
+            spans = list(self._spans)
+        return spans if last_k is None else spans[-int(last_k):]
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def phase_summary(self, reset: bool = False) -> dict[str, dict[str, float]]:
+        """(cat.name) -> {count, total_ms, mean_ms} since the last reset —
+        the cheap aggregate the training log interval and the bench obs
+        snapshot publish without walking the ring."""
+        with self._lock:
+            out = {
+                f"{cat}.{name}": {
+                    "count": int(count),
+                    "total_ms": round(total_us / 1e3, 3),
+                    "mean_ms": round(total_us / 1e3 / count, 3) if count else 0.0,
+                }
+                for (cat, name), (count, total_us) in self._totals.items()
+            }
+            if reset:
+                self._totals.clear()
+        return out
+
+    # -- export --------------------------------------------------------------
+
+    def to_chrome_trace(self, last_k: int | None = None) -> dict:
+        """Chrome trace-event JSON (dict): `X` duration events per span plus
+        process/thread metadata naming the host lane."""
+        pid = os.getpid()
+        spans = self.snapshot(last_k)
+        events: list[dict] = [{
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": HOST_PROCESS_NAME},
+        }]
+        seen_tids: dict[int, str] = {}
+        for s in spans:
+            if s.tid not in seen_tids:
+                seen_tids[s.tid] = s.thread_name
+                events.append({
+                    "ph": "M", "pid": pid, "tid": s.tid,
+                    "name": "thread_name",
+                    "args": {"name": s.thread_name},
+                })
+            ev = {
+                "ph": "X", "pid": pid, "tid": s.tid, "name": s.name,
+                "cat": s.cat, "ts": round(s.ts_us, 3),
+                "dur": round(s.dur_us, 3),
+            }
+            if s.args:
+                ev["args"] = {k: _jsonable(v) for k, v in s.args.items()}
+            events.append(ev)
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": events,
+            "metadata": {
+                "producer": HOST_PROCESS_NAME,
+                "dropped_spans": self.dropped,
+            },
+        }
+
+    def export(self, path: str, last_k: int | None = None) -> str:
+        """Write the Chrome-trace JSON; name the file `*.trace.json` so
+        tools/profile_summary.py's glob finds it next to device traces."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.to_chrome_trace(last_k), fh)
+        os.replace(tmp, path)
+        return path
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+# shared disabled tracer: a safe default for call sites that take an
+# optional tracer (never enable it — it is process-global)
+NULL_TRACER = Tracer(enabled=False)

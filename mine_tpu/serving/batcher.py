@@ -30,6 +30,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from mine_tpu.obs.trace import NULL_TRACER, Tracer
 from mine_tpu.serving.cache import CacheKey, MPIEntry
 
 # (entry, poses (N,4,4)) -> (rgb (N,H,W,3), disp (N,H,W,1))
@@ -62,6 +63,7 @@ class MicroBatcher:
         max_delay_ms: float = 4.0,
         max_batch_poses: int = 64,
         metrics: Any | None = None,
+        tracer: Tracer | None = None,
     ):
         if max_batch_poses < 1:
             raise ValueError(f"max_batch_poses must be >= 1, got {max_batch_poses}")
@@ -69,6 +71,7 @@ class MicroBatcher:
         self.max_delay_s = max(0.0, max_delay_ms) / 1e3
         self.max_batch_poses = int(max_batch_poses)
         self._metrics = metrics
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self._pending: deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._stop = False
@@ -134,6 +137,7 @@ class MicroBatcher:
                 self._cond.wait()
             if not self._pending:
                 return None  # stopping and drained
+            coalesce_t0 = time.perf_counter()
             seed = self._pending.popleft()
             group = [seed]
             n_poses = seed.poses.shape[0]
@@ -160,6 +164,10 @@ class MicroBatcher:
                     break
                 self._cond.wait(timeout=remaining)
             self._gauge_locked()
+            self._tracer.record(
+                "coalesce", "serve", coalesce_t0, time.perf_counter(),
+                requests=len(group), poses=n_poses,
+            )
             return group
 
     def _run(self) -> None:
@@ -171,12 +179,26 @@ class MicroBatcher:
 
     def _dispatch(self, group: list[_Pending]) -> None:
         poses = np.concatenate([p.poses for p in group], axis=0)
+        now = time.monotonic()
         if self._metrics is not None:
             self._metrics.batch_dispatches.inc()
             if len(group) >= 2:
                 self._metrics.batch_coalesced_dispatches.inc()
+            qd = getattr(self._metrics, "queue_delay", None)
+            if qd is not None:
+                for p in group:
+                    qd.observe(now - p.enqueued_at)
+        # one queue-wait span per group, from the oldest member's enqueue
+        # (enqueued_at is monotonic; the tracer wants perf_counter — map
+        # the age onto the tracer clock)
+        age = now - group[0].enqueued_at
+        t1 = time.perf_counter()
+        self._tracer.record("queue_wait", "serve", t1 - age, t1,
+                            requests=len(group))
         try:
-            rgb, disp = self._render_fn(group[0].entry, poses)
+            with self._tracer.span("dispatch", cat="serve",
+                                   poses=poses.shape[0]):
+                rgb, disp = self._render_fn(group[0].entry, poses)
         except BaseException as exc:  # noqa: BLE001 - forwarded to callers
             for p in group:
                 p.future.set_exception(exc)

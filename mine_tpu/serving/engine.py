@@ -35,11 +35,13 @@ S_coarse + S_fine planes.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 import numpy as np
 
 from mine_tpu.config import Config
+from mine_tpu.obs.cost import StepCost, compiled_cost, resolve_peak_flops
 from mine_tpu.serving.cache import MPIEntry
 from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
 
@@ -82,6 +84,10 @@ class _Bucket:
         self.k = jnp.asarray(fov_intrinsics(h, w, engine.fov_deg))[None]
         self._predict_exec = None
         self._render_execs: dict[int, Any] = {}
+        # XLA cost analysis per executable (obs/cost.py), captured at
+        # compile time — what the /metrics MFU gauge divides by step time
+        self.predict_cost: StepCost | None = None
+        self.render_costs: dict[int, StepCost] = {}
         self._lock = threading.Lock()
 
     # -- executables ---------------------------------------------------------
@@ -121,6 +127,7 @@ class _Bucket:
                         self.cfg, variables, img, self.disparity, self.k
                     )
                 self._predict_exec = lowered.compile()
+                self.predict_cost = compiled_cost(self._predict_exec)
                 self.engine._count_compile("predict")
             return self._predict_exec
 
@@ -148,6 +155,7 @@ class _Bucket:
                     jax.ShapeDtypeStruct((n_poses, 4, 4), np.float32),
                 )
                 exe = lowered.compile()
+                self.render_costs[n_poses] = compiled_cost(exe)
                 self._render_execs[n_poses] = exe
                 self.engine._count_compile("render")
             return exe
@@ -171,6 +179,7 @@ class RenderEngine:
         pose_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64),
         fov_deg: float = 90.0,
         compositor: str = "streaming",
+        peak_flops_override: float = 0.0,
     ):
         import jax
 
@@ -207,6 +216,11 @@ class RenderEngine:
             cfg.data.img_h, cfg.data.img_w, cfg.mpi.num_bins_coarse
         )
         self.compiles = 0  # total executables built (also in metrics)
+        # the MFU gauge's denominator (obs/cost.py table, or the explicit
+        # override — the only honest choice on CPU); None => no MFU gauge
+        self.peak_flops = resolve_peak_flops(
+            jax.devices()[0], peak_flops_override
+        )
         self._buckets: dict[BucketSpec, _Bucket] = {}
         self._buckets_lock = threading.Lock()
 
@@ -279,6 +293,10 @@ class RenderEngine:
             disparity = bucket.disparity
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
+            if bucket.predict_cost is not None and bucket.predict_cost.flops:
+                self.metrics.step_flops.set(
+                    bucket.predict_cost.flops, kind="predict"
+                )
         return MPIEntry(
             mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma, disparity=disparity,
             k=bucket.k, bucket=bucket.spec,
@@ -307,6 +325,8 @@ class RenderEngine:
         bucket = self.bucket(entry.bucket)
         max_b = self.pose_buckets[-1]
         rgb_parts, disp_parts = [], []
+        total_flops = 0.0
+        t0 = time.perf_counter()
         for start in range(0, n, max_b):
             chunk = poses[start:start + max_b]
             nb = self._pose_bucket(chunk.shape[0])
@@ -324,9 +344,22 @@ class RenderEngine:
             )
             rgb_parts.append(np.asarray(jax.device_get(rgb))[:chunk.shape[0]])
             disp_parts.append(np.asarray(jax.device_get(disp))[:chunk.shape[0]])
+            cost = bucket.render_costs.get(nb)
+            if cost is not None and cost.flops:
+                total_flops += cost.flops
+        elapsed = time.perf_counter() - t0
         if self.metrics is not None:
             self.metrics.rendered_frames.inc(n)
             self.metrics.renders_per_sec.record(n)
+            # live cost gauges: the compiled executables' XLA FLOPs over
+            # the measured dispatch wall time (device_get included — the
+            # number a capacity plan sees, not a device-only ideal)
+            if total_flops and elapsed > 0:
+                achieved = total_flops / elapsed
+                self.metrics.step_flops.set(total_flops, kind="render")
+                self.metrics.achieved_tflops.set(achieved / 1e12)
+                if self.peak_flops:
+                    self.metrics.mfu.set(achieved / self.peak_flops)
         if len(rgb_parts) == 1:
             return rgb_parts[0], disp_parts[0]
         return np.concatenate(rgb_parts), np.concatenate(disp_parts)

@@ -65,9 +65,20 @@ class ServingMetrics:
             "mine_serve_requests_total",
             "HTTP requests by endpoint and status code",
         )
-        self.request_latency = r.summary(
+        self.request_latency = r.histogram(
             "mine_serve_request_latency_seconds",
-            "request wall time by endpoint (windowed p50/p95)",
+            "request wall time by endpoint (cumulative le buckets)",
+        )
+        self.queue_delay = r.histogram(
+            "mine_serve_queue_delay_seconds",
+            "time a render request waited in the micro-batcher before its "
+            "group dispatched (the latency cost of coalescing)",
+        )
+
+        # host-span tracing (obs/trace.py wired via ServingApp)
+        self.trace_spans = r.counter(
+            "mine_serve_trace_spans_total",
+            "host spans recorded by the request-lifecycle tracer, by cat",
         )
 
         # engine
@@ -89,6 +100,23 @@ class ServingMetrics:
             "mine_serve_renders_per_sec",
             "rendered frames per second over the trailing window",
         ))
+
+        # cost accounting (obs/cost.py): XLA cost analysis of the render
+        # executables over measured dispatch time
+        self.step_flops = r.gauge(
+            "mine_serve_step_flops",
+            "FLOPs of the most recently dispatched compiled executable "
+            "(XLA cost analysis), by kind",
+        )
+        self.mfu = r.gauge(
+            "mine_serve_mfu",
+            "render-dispatch model FLOPs utilization over the device peak "
+            "(absent until a render resolves and the peak is known)",
+        )
+        self.achieved_tflops = r.gauge(
+            "mine_serve_achieved_tflops_per_sec",
+            "achieved TFLOP/s of the last render dispatch",
+        )
 
         # MPI cache
         self.cache_hits = r.counter(

@@ -19,6 +19,10 @@ Endpoints:
                   of one MPI coalesce into one dispatch (batcher.py).
   GET  /healthz   liveness + engine/bucket/cache snapshot.
   GET  /metrics   Prometheus text exposition (serving/metrics.py names).
+  GET  /debug/trace  the request-lifecycle host spans (parse, queue-wait,
+                  coalesce, dispatch, encode — obs/trace.py) as
+                  Chrome-trace JSON: drop it into chrome://tracing, or
+                  point tools/profile_summary.py at a saved copy.
 
 CLI: python -m mine_tpu.serving.server --workspace <train workspace>
 restores params only (training/checkpoint.py load_for_serving), pre-warms
@@ -32,6 +36,7 @@ import base64
 import hashlib
 import io
 import json
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -41,6 +46,7 @@ from typing import Any
 import numpy as np
 
 from mine_tpu.config import Config
+from mine_tpu.obs.trace import Tracer
 from mine_tpu.serving.batcher import MicroBatcher
 from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
 from mine_tpu.serving.engine import BucketSpec, RenderEngine
@@ -101,11 +107,23 @@ class ServingApp:
         request_timeout_s: float = 300.0,
         metrics: ServingMetrics | None = None,
         allowed_buckets: list[BucketSpec] | None = None,
+        trace_enabled: bool = True,
+        trace_buffer_spans: int = 4096,
+        peak_flops_override: float = 0.0,
     ):
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # request-lifecycle spans default ON (unlike training): a span is
+        # nanoseconds against a millisecond render, and /debug/trace on a
+        # misbehaving server is worth far more than the ring's few MB.
+        # Every recorded span also ticks the trace-counter family.
+        self.tracer = Tracer(
+            enabled=trace_enabled, max_spans=trace_buffer_spans,
+            on_span=lambda span: self.metrics.trace_spans.inc(cat=span.cat),
+        )
         self.engine = RenderEngine(
             cfg, params, batch_stats, checkpoint_step=checkpoint_step,
             metrics=self.metrics, fov_deg=fov_deg,
+            peak_flops_override=peak_flops_override,
         )
         # shapes an untrusted /predict body may request: each admitted spec
         # costs a full XLA compile + an O(S*H*W) resident MPI, so the set is
@@ -118,6 +136,7 @@ class ServingApp:
         self.batcher = MicroBatcher(
             self.engine.render, max_delay_ms=max_delay_ms,
             max_batch_poses=max_batch_poses, metrics=self.metrics,
+            tracer=self.tracer,
         ).start()
         self.request_timeout_s = request_timeout_s
         self._started_at = time.time()
@@ -203,6 +222,8 @@ class ServingApp:
             "cache_entries": len(self.cache),
             "cache_bytes_resident": self.cache.bytes_resident,
             "queue_depth": self.batcher.queue_depth(),
+            "trace_enabled": self.tracer.enabled,
+            "trace_spans_buffered": len(self.tracer),
         }
 
     def close(self) -> None:
@@ -260,6 +281,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, app.metrics.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
             return 200, "metrics"
+        if method == "GET" and path == "/debug/trace":
+            self._send_json(200, app.tracer.to_chrome_trace())
+            return 200, "debug_trace"
         if method == "POST" and path == "/predict":
             return self._predict(app), "predict"
         if method == "POST" and path == "/render":
@@ -302,25 +326,27 @@ class _Handler(BaseHTTPRequestHandler):
     # -- endpoints -----------------------------------------------------------
 
     def _predict(self, app: ServingApp) -> int:
-        body = self._read_body()
-        spec = None
-        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
-        if ctype == "application/json":
-            try:
-                req = json.loads(body)
-                image_bytes = base64.b64decode(req["image_b64"])
-                if req.get("bucket") is not None:
-                    spec = tuple(int(v) for v in req["bucket"])
-            except (KeyError, ValueError, TypeError) as exc:
-                self._send_json(400, {"error": f"bad predict body: {exc}"})
-                return 400
-        else:
-            image_bytes = body  # raw PNG/JPEG bytes
+        with app.tracer.span("parse", cat="serve", endpoint="predict"):
+            body = self._read_body()
+            spec = None
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            if ctype == "application/json":
+                try:
+                    req = json.loads(body)
+                    image_bytes = base64.b64decode(req["image_b64"])
+                    if req.get("bucket") is not None:
+                        spec = tuple(int(v) for v in req["bucket"])
+                except (KeyError, ValueError, TypeError) as exc:
+                    self._send_json(400, {"error": f"bad predict body: {exc}"})
+                    return 400
+            else:
+                image_bytes = body  # raw PNG/JPEG bytes
         if not image_bytes:
             self._send_json(400, {"error": "empty image"})
             return 400
         try:
-            result = app.predict(image_bytes, spec)
+            with app.tracer.span("predict", cat="serve"):
+                result = app.predict(image_bytes, spec)
         except (ValueError, OSError) as exc:
             # bad bucket (ValueError) or undecodable/truncated image bytes —
             # PIL's UnidentifiedImageError subclasses OSError, not ValueError
@@ -331,10 +357,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _render(self, app: ServingApp) -> int:
         try:
-            req = json.loads(self._read_body())
-            key_str = req["mpi_key"]
-            key_from_str(key_str)  # malformed keys are a 400, not a 500
-            poses = _poses_from_body(req)
+            with app.tracer.span("parse", cat="serve", endpoint="render"):
+                req = json.loads(self._read_body())
+                key_str = req["mpi_key"]
+                key_from_str(key_str)  # malformed keys are a 400, not a 500
+                poses = _poses_from_body(req)
         except (KeyError, ValueError, TypeError) as exc:
             self._send_json(400, {"error": f"bad render body: {exc}"})
             return 400
@@ -348,22 +375,24 @@ class _Handler(BaseHTTPRequestHandler):
             return 404
         from mine_tpu.inference.video import normalize_disparity, to_uint8
 
-        frames = [
-            base64.b64encode(_encode_png(f)).decode()
-            for f in to_uint8(np.clip(rgb, 0.0, 1.0))
-        ]
-        out: dict[str, Any] = {
-            "mpi_key": key_str,
-            "num_frames": int(rgb.shape[0]),
-            "height": int(rgb.shape[1]),
-            "width": int(rgb.shape[2]),
-            "frames_png_b64": frames,
-        }
-        if req.get("include_disparity"):
-            out["disparity_png_b64"] = [
+        with app.tracer.span("encode", cat="serve",
+                             frames=int(rgb.shape[0])):
+            frames = [
                 base64.b64encode(_encode_png(f)).decode()
-                for f in to_uint8(normalize_disparity(disp))[..., 0]
+                for f in to_uint8(np.clip(rgb, 0.0, 1.0))
             ]
+            out: dict[str, Any] = {
+                "mpi_key": key_str,
+                "num_frames": int(rgb.shape[0]),
+                "height": int(rgb.shape[1]),
+                "width": int(rgb.shape[2]),
+                "frames_png_b64": frames,
+            }
+            if req.get("include_disparity"):
+                out["disparity_png_b64"] = [
+                    base64.b64encode(_encode_png(f)).decode()
+                    for f in to_uint8(normalize_disparity(disp))[..., 0]
+                ]
         self._send_json(200, out)
         return 200
 
@@ -424,6 +453,16 @@ def main(argv: list[str] | None = None) -> None:
         "--allow-random-init", action="store_true",
         help="serve untrained weights when no checkpoint exists (smoke only)",
     )
+    parser.add_argument(
+        "--no-trace", action="store_true",
+        help="disable request-lifecycle host spans (/debug/trace serves an "
+        "empty trace; the trace-counter metric family stays at 0)",
+    )
+    parser.add_argument(
+        "--peak-flops", type=float, default=0.0,
+        help="peak FLOP/s for the MFU gauge when the device kind has no "
+        "published table entry (obs/cost.py) — e.g. a CPU smoke",
+    )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -445,7 +484,20 @@ def main(argv: list[str] | None = None) -> None:
         cache_bytes=args.cache_mb << 20, max_delay_ms=args.max_delay_ms,
         max_batch_poses=args.max_batch_poses, fov_deg=args.fov,
         allowed_buckets=extra_buckets,
+        trace_enabled=not args.no_trace,
+        peak_flops_override=args.peak_flops,
     )
+    # flight recorder: SIGTERM/SIGUSR1 dump thread stacks + the last-K
+    # request spans to the workspace sidecar (no stall watchdog here — an
+    # idle server is healthy, unlike a training step that stopped)
+    from mine_tpu.obs import FlightRecorder
+    from mine_tpu.training.checkpoint import local_sidecar_dir
+
+    flight = FlightRecorder(
+        os.path.join(local_sidecar_dir(args.workspace), "flight"),
+        tracer=app.tracer,
+        get_status=lambda: app.health(),
+    ).start()
     if not args.no_warmup:
         built = app.engine.warmup(specs=sorted(app.allowed_buckets))
         print(f"warmup: {built} executables compiled "
@@ -453,7 +505,7 @@ def main(argv: list[str] | None = None) -> None:
     server = make_server(app, args.host, args.port, verbose=args.verbose)
     host, port = server.server_address[:2]
     print(f"serving checkpoint step {step} on http://{host}:{port} "
-          f"(/predict /render /healthz /metrics)")
+          f"(/predict /render /healthz /metrics /debug/trace)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -461,6 +513,7 @@ def main(argv: list[str] | None = None) -> None:
     finally:
         server.shutdown()
         app.close()
+        flight.stop()
 
 
 if __name__ == "__main__":

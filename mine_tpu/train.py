@@ -55,7 +55,10 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--workspace", default="workspace/run")
     parser.add_argument(
         "--profile-steps", type=int, default=0,
-        help="trace this many steps with jax.profiler into <workspace>/profile",
+        help="trace this many steps with jax.profiler into "
+        "<workspace>/profile (equivalently obs.profile_steps; the window "
+        "starts obs.profile_start_offset steps in, and with obs.enabled "
+        "the host-span trace lands in the same directory)",
     )
     args = parser.parse_args(argv)
 

@@ -6,11 +6,21 @@ Reference: synthesis_task.py train/train_epoch/run_eval (:609-690, :496-527)
 step/optimizer/PRNG for bitwise resume and auto-resume from the workspace;
 every log line carries imgs/sec; loss fetches happen once per log interval so
 steps stay fully async on device.
+
+Observability (cfg.obs.*, mine_tpu/obs/): when enabled, every step is
+broken into host spans (data/step/sync/log/ckpt) on a bounded ring with
+Chrome-trace export next to the jax.profiler device traces; a flight
+recorder dumps thread stacks + the last-K spans on SIGTERM/SIGUSR1 or a
+stall; and the train step is AOT-compiled once so XLA's own cost analysis
+feeds a live MFU gauge (utils/metrics.py registry + MetricWriter scalars).
+Disabled (the default), the spans are shared no-op context managers and
+none of it costs anything.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Iterable
 
 import jax
@@ -19,6 +29,14 @@ import numpy as np
 from mine_tpu.config import Config
 from mine_tpu.data import prefetch
 from mine_tpu.losses import load_lpips_params
+from mine_tpu.obs import FlightRecorder, Tracer
+from mine_tpu.obs.cost import (
+    achieved_fraction,
+    compiled_cost,
+    compute_mfu,
+    resolve_peak_flops,
+    resolve_peak_hbm_bytes,
+)
 from mine_tpu.parallel import (
     DATA_AXIS,
     init_multihost,
@@ -34,8 +52,8 @@ from mine_tpu.training.optimizer import learning_rates, make_optimizer
 from mine_tpu.training.step import build_model, init_state
 from mine_tpu.utils import (
     AverageMeter,
+    MetricsRegistry,
     MetricWriter,
-    StepTimer,
     make_logger,
     normalize_disparity_for_vis,
 )
@@ -60,6 +78,36 @@ def staged_batches(mesh, num_workers: int, epoch_iter: Iterable[dict]) -> Iterab
     )
 
 
+class TrainObsMetrics:
+    """Training's live gauge set on a utils/metrics.py registry — the
+    queryable twin of the MetricWriter scalars (prefix: `mine_train_`)."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self.mfu = r.gauge(
+            "mine_train_mfu",
+            "model FLOPs utilization: XLA cost-analysis FLOPs per step over "
+            "measured step time, divided by the device peak",
+        )
+        self.tflops_per_sec = r.gauge(
+            "mine_train_tflops_per_sec",
+            "achieved model TFLOP/s of the compiled train step",
+        )
+        self.step_flops = r.gauge(
+            "mine_train_step_flops",
+            "FLOPs of one compiled train step (XLA cost analysis)",
+        )
+        self.hbm_fraction = r.gauge(
+            "mine_train_achieved_hbm_fraction",
+            "bytes-accessed per step over step time, divided by peak HBM "
+            "bandwidth (absent when the peak is unknown)",
+        )
+        self.imgs_per_sec = r.gauge(
+            "mine_train_imgs_per_sec", "global training throughput",
+        )
+
+
 class Trainer:
     """Owns mesh, model, state, and the jitted steps; `fit` runs epochs."""
 
@@ -71,7 +119,27 @@ class Trainer:
         # writes them remotely); params.yaml / logs / TB events / profiler
         # traces use plain file IO and land in a derived local dir instead
         self.local_dir = ckpt.local_sidecar_dir(workspace)
-        self.profile_steps = profile_steps
+        # the CLI flag wins; else the obs.profile_steps knob (both count
+        # steps; the window starts obs.profile_start_offset steps in)
+        self.profile_steps = profile_steps or cfg.obs.profile_steps
+        self.tracer = Tracer(
+            enabled=cfg.obs.enabled, max_spans=cfg.obs.trace_buffer_spans
+        )
+        self.obs_metrics = TrainObsMetrics()
+        self._progress: dict[str, Any] = {}
+        self.flight: FlightRecorder | None = None
+        if cfg.obs.enabled:
+            self.flight = FlightRecorder(
+                os.path.join(self.local_dir, "flight"),
+                tracer=self.tracer,
+                watchdog_timeout_s=cfg.obs.flight_watchdog_s,
+                last_k_spans=cfg.obs.flight_last_k_spans,
+                get_status=self._flight_status,
+            )
+        self._train_cost = None  # StepCost of the AOT-compiled step
+        self._compiled_train_step = None
+        self._peak_flops = None
+        self._peak_hbm = None
         self.mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
         self.logger = make_logger(self.local_dir)
         self.writer = MetricWriter(self.local_dir)
@@ -155,7 +223,6 @@ class Trainer:
         eval_step = make_parallel_eval_step(cfg, self.model, self.mesh, lpips_params)
 
         meters = {k: AverageMeter(k) for k in LOSS_KEYS}
-        timer = StepTimer(self.global_batch)
         start_epoch = start_step // steps_per_epoch + 1
 
         if start_step:
@@ -165,11 +232,13 @@ class Trainer:
             dict(self.mesh.shape), self.global_batch, steps_per_epoch,
         )
 
+        if self.flight is not None:
+            self.flight.start()
         self._live_state = state  # emergency-save target from the first step on
         try:
             last_val = self._fit_epochs(
                 cfg, train_ds, val_ds, state, train_step, eval_step,
-                manager, meters, timer, start_step,
+                manager, meters, start_step,
             )
         except (KeyboardInterrupt, Exception):
             # failure containment (SURVEY.md §5.3 — the reference has none):
@@ -177,6 +246,8 @@ class Trainer:
             # run auto-resumes instead of losing the epoch. The emergency save
             # itself may fail (e.g. the device poisoned the state arrays) —
             # never let that mask the original error.
+            if self.flight is not None:
+                self.flight.dump("train_exception")
             try:
                 host_state = jax.device_get(self._live_state)
                 step_now = int(host_state.step)
@@ -191,60 +262,189 @@ class Trainer:
             raise
         finally:
             self._live_state = None  # don't pin the state in HBM after fit
+            if self.flight is not None:
+                self.flight.stop()
+            self._export_host_trace()
         return last_val
+
+    def _flight_status(self) -> dict:
+        """What a flight dump's meta.json records about this trainer: the
+        progress counters plus the live gauge values (a stalled run's last
+        known MFU/throughput is exactly the evidence the dump exists for)."""
+        m = self.obs_metrics
+        return {
+            **self._progress,
+            "gauges": {
+                "mfu": m.mfu.value(),
+                "tflops_per_sec": m.tflops_per_sec.value(),
+                "step_flops": m.step_flops.value(),
+                "imgs_per_sec": m.imgs_per_sec.value(),
+            },
+        }
+
+    def _host_trace_path(self) -> str:
+        """Host spans land next to the device traces (`<sidecar>/profile`)
+        with a `*.trace.json` name, so tools/profile_summary.py's glob
+        picks up both halves of a run from one directory."""
+        return os.path.join(self.local_dir, "profile", "host_spans.trace.json")
+
+    def _export_host_trace(self) -> None:
+        if not self.tracer.enabled or not len(self.tracer):
+            return
+        try:
+            self.tracer.export(self._host_trace_path())
+        except OSError:
+            self.logger.exception("host trace export failed")
+
+    def _prepare_cost_accounting(self, train_step, state, batch):
+        """AOT-compile the train step once (jit would compile the same HLO
+        anyway — this just makes the Compiled handle inspectable), pull
+        XLA's own FLOPs/bytes from it, and resolve the device peaks the
+        MFU/bandwidth gauges divide by. Any failure falls back to the jit
+        path: cost accounting is an instrument, never a crash."""
+        cfg = self.cfg
+        try:
+            with self.tracer.span("aot_compile", cat="train"):
+                compiled = train_step.lower(state, batch).compile()
+            self._train_cost = compiled_cost(compiled)
+            self._compiled_train_step = compiled
+        except Exception:  # noqa: BLE001 - backend-dependent surface
+            self.logger.exception(
+                "AOT train-step cost accounting unavailable; continuing "
+                "on the jit path without MFU gauges"
+            )
+            return train_step
+        self._peak_flops = resolve_peak_flops(
+            jax.devices()[0], cfg.obs.peak_flops_override
+        )
+        self._peak_hbm = resolve_peak_hbm_bytes(jax.devices()[0])
+        if self._train_cost.flops:
+            self.obs_metrics.step_flops.set(self._train_cost.flops)
+            self.writer.scalar(
+                "obs/step_flops", self._train_cost.flops, int(state.step)
+            )
+        self.logger.info(
+            "obs cost accounting: step flops=%s bytes=%s peak_flops=%s",
+            self._train_cost.flops, self._train_cost.bytes_accessed,
+            self._peak_flops,
+        )
+        return compiled
+
+    def _publish_mfu(self, step_seconds: float, global_step: int) -> None:
+        cost = self._train_cost
+        if cost is None or not cost.flops or step_seconds <= 0:
+            return
+        achieved = cost.flops / step_seconds
+        self.obs_metrics.tflops_per_sec.set(achieved / 1e12)
+        self.writer.scalar("obs/tflops_per_sec", achieved / 1e12, global_step)
+        self.writer.scalar("obs/step_flops", cost.flops, global_step)
+        mfu = compute_mfu(cost.flops, step_seconds, self._peak_flops)
+        if mfu is not None:
+            self.obs_metrics.mfu.set(mfu)
+            self.writer.scalar("obs/mfu", mfu, global_step)
+        hbm = achieved_fraction(cost.bytes_accessed, step_seconds, self._peak_hbm)
+        if hbm is not None:
+            self.obs_metrics.hbm_fraction.set(hbm)
+            self.writer.scalar("obs/achieved_hbm_fraction", hbm, global_step)
+
+    def _publish_phases(self, global_step: int) -> None:
+        for phase, stats in self.tracer.phase_summary(reset=True).items():
+            if phase.startswith("train."):
+                self.writer.scalar(
+                    f"obs/phase_{phase[len('train.'):]}_ms",
+                    stats["mean_ms"], global_step,
+                )
 
     def _fit_epochs(
         self, cfg, train_ds, val_ds, state, train_step, eval_step,
-        manager, meters, timer, start_step,
+        manager, meters, start_step,
     ) -> dict[str, float]:
         steps_per_epoch = len(train_ds)
         global_step = start_step
         start_epoch = start_step // steps_per_epoch + 1
         last_val: dict[str, float] = {}
+        tracer = self.tracer
+        cost_pending = cfg.obs.enabled and cfg.obs.cost_enabled
+        profile_at = start_step + cfg.obs.profile_start_offset
+        t_log = time.perf_counter()
+        steps_since_log = 0  # actual count: epoch tails leave remainders, so
+        # the first log of an epoch can span MORE than log_interval steps
         for epoch in range(start_epoch, cfg.training.epochs + 1):
             for m in meters.values():
                 m.reset()
-            batches = self._staged_batches(train_ds.epoch(epoch))
-            for step_in_epoch, batch in enumerate(batches, start=1):
-                if self.profile_steps and global_step == start_step + 5:
+            self._progress.update(epoch=epoch, global_step=global_step)
+            batches = iter(self._staged_batches(train_ds.epoch(epoch)))
+            step_in_epoch = 0
+            while True:
+                with tracer.span("data", cat="train"):
+                    batch = next(batches, None)
+                if batch is None:
+                    break
+                step_in_epoch += 1
+                if cost_pending:
+                    cost_pending = False
+                    train_step = self._prepare_cost_accounting(
+                        train_step, state, batch
+                    )
+                if self.profile_steps and global_step == profile_at:
                     jax.profiler.start_trace(os.path.join(self.local_dir, "profile"))
-                state, loss_dict = train_step(state, batch)
+                with tracer.span("step", cat="train", step=global_step + 1):
+                    state, loss_dict = train_step(state, batch)
                 self._live_state = state  # for the emergency checkpoint
                 global_step += 1
-                timer.tick()
-                if self.profile_steps and global_step == start_step + 5 + self.profile_steps:
+                steps_since_log += 1
+                self._progress["global_step"] = global_step
+                if self.flight is not None:
+                    self.flight.heartbeat(step=global_step)
+                if (self.profile_steps
+                        and global_step == profile_at + self.profile_steps):
                     jax.block_until_ready(loss_dict["loss"])
                     jax.profiler.stop_trace()
+                    self._export_host_trace()
                     self.logger.info("profile trace written to %s/profile", self.local_dir)
 
                 if step_in_epoch % cfg.training.log_interval == 0:
                     # one transfer for the whole dict: per-key float() would
                     # block on a device sync PER KEY per log step
-                    host_losses = {
-                        k: float(v)
-                        for k, v in jax.device_get(
-                            {k: loss_dict[k] for k in LOSS_KEYS}
-                        ).items()
-                    }
-                    for k, v in host_losses.items():
-                        meters[k].update(v, cfg.training.log_interval)
-                    lrs = learning_rates(cfg, steps_per_epoch, global_step)
-                    rate = timer.rate_and_reset()
-                    self.logger.info(
-                        "epoch [%03d] step [%d/%d] global_step=%d "
-                        "loss=%.4f rgb_tgt=%.4f ssim_tgt=%.4f disp_src=%.4f "
-                        "psnr=%.2f lr=%.6f imgs/sec=%.1f",
-                        epoch, step_in_epoch, steps_per_epoch, global_step,
-                        host_losses["loss"], host_losses["loss_rgb_tgt"],
-                        host_losses["loss_ssim_tgt"], host_losses["loss_disp_pt3dsrc"],
-                        host_losses["psnr_tgt"], lrs["backbone_lr"], rate,
-                    )
-                    self.writer.scalars(host_losses, global_step, prefix="train/")
-                    self.writer.scalar("train/imgs_per_sec", rate, global_step)
-                    self.writer.scalar("train/backbone_lr", lrs["backbone_lr"], global_step)
+                    with tracer.span("sync", cat="train", step=global_step):
+                        host_losses = {
+                            k: float(v)
+                            for k, v in jax.device_get(
+                                {k: loss_dict[k] for k in LOSS_KEYS}
+                            ).items()
+                        }
+                    with tracer.span("log", cat="train", step=global_step):
+                        for k, v in host_losses.items():
+                            meters[k].update(v, cfg.training.log_interval)
+                        lrs = learning_rates(cfg, steps_per_epoch, global_step)
+                        now = time.perf_counter()
+                        interval_s = max(now - t_log, 1e-9)
+                        t_log = now
+                        n_steps = max(steps_since_log, 1)
+                        steps_since_log = 0
+                        rate = n_steps * self.global_batch / interval_s
+                        self.obs_metrics.imgs_per_sec.set(rate)
+                        self.logger.info(
+                            "epoch [%03d] step [%d/%d] global_step=%d "
+                            "loss=%.4f rgb_tgt=%.4f ssim_tgt=%.4f disp_src=%.4f "
+                            "psnr=%.2f lr=%.6f imgs/sec=%.1f",
+                            epoch, step_in_epoch, steps_per_epoch, global_step,
+                            host_losses["loss"], host_losses["loss_rgb_tgt"],
+                            host_losses["loss_ssim_tgt"], host_losses["loss_disp_pt3dsrc"],
+                            host_losses["psnr_tgt"], lrs["backbone_lr"], rate,
+                        )
+                        self.writer.scalars(host_losses, global_step, prefix="train/")
+                        self.writer.scalar("train/imgs_per_sec", rate, global_step)
+                        self.writer.scalar("train/backbone_lr", lrs["backbone_lr"], global_step)
+                        self._publish_mfu(interval_s / n_steps, global_step)
+                    if tracer.enabled:
+                        # AFTER the log span closes, so this interval's own
+                        # sync/log phases are in the summary it publishes
+                        self._publish_phases(global_step)
 
                 if global_step % cfg.training.checkpoint_interval == 0:
-                    ckpt.save(manager, jax.device_get(state), global_step)
+                    with tracer.span("ckpt", cat="train", step=global_step):
+                        ckpt.save(manager, jax.device_get(state), global_step)
                     self.logger.info("checkpoint saved @ step %d", global_step)
 
                 if val_ds is not None and (
@@ -266,8 +466,9 @@ class Trainer:
                 )
                 self.writer.scalars(epoch_avg, global_step, prefix="train_epoch/")
 
-        ckpt.save(manager, jax.device_get(state), global_step)
-        ckpt.wait_until_finished(manager)
+        with tracer.span("ckpt", cat="train", step=global_step):
+            ckpt.save(manager, jax.device_get(state), global_step)
+            ckpt.wait_until_finished(manager)
         self.writer.flush()
         return last_val
 

@@ -3,13 +3,13 @@
 from mine_tpu.utils.logging import (
     AverageMeter,
     MetricWriter,
-    StepTimer,
     make_logger,
     normalize_disparity_for_vis,
 )
 from mine_tpu.utils.metrics import (
     Counter,
     Gauge,
+    Histogram,
     MetricsRegistry,
     Summary,
 )

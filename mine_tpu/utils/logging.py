@@ -12,7 +12,6 @@ import json
 import logging
 import os
 import sys
-import time
 from typing import Any
 
 import numpy as np
@@ -125,23 +124,3 @@ def normalize_disparity_for_vis(disp: np.ndarray) -> np.ndarray:
     lo = disp.min(axis=(1, 2, 3), keepdims=True)
     hi = disp.max(axis=(1, 2, 3), keepdims=True)
     return (disp - lo) / np.maximum(hi - lo, 1e-8)
-
-
-class StepTimer:
-    """imgs/sec over a rolling window (the §5.1 gap: the reference logs no
-    timing at all)."""
-
-    def __init__(self, batch_size: int):
-        self.batch_size = batch_size
-        self._t0 = time.perf_counter()
-        self._steps = 0
-
-    def tick(self) -> None:
-        self._steps += 1
-
-    def rate_and_reset(self) -> float:
-        now = time.perf_counter()
-        rate = self._steps * self.batch_size / max(now - self._t0, 1e-9)
-        self._t0 = now
-        self._steps = 0
-        return rate

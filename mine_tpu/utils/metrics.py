@@ -5,8 +5,9 @@ No reference analog and no new dependency: the serving subsystem
 hit/miss, bytes resident, queue depth, latency quantiles — over a plain
 HTTP `/metrics` endpoint, and this image has no `prometheus_client`. The
 registry implements the minimal subset of the Prometheus data model the
-serving metrics need (counters, gauges, label sets, and a windowed summary
-for latency quantiles) and renders text exposition format 0.0.4.
+serving metrics need (counters, gauges, label sets, cumulative-bucket
+histograms for latency SLOs, and a windowed summary) and renders text
+exposition format 0.0.4.
 
 Thread-safety: every mutation takes the registry lock — the serving stack
 updates metrics from HTTP handler threads and the batcher worker thread
@@ -18,6 +19,7 @@ snapshot.
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
 from collections import deque
 
 
@@ -99,6 +101,119 @@ class Gauge(_Family):
     def value(self, **labels: str) -> float:
         with self.registry._lock:
             return self._children.get(self._key(labels), 0.0)
+
+
+class Histogram(_Family):
+    """Cumulative-bucket histogram (the real Prometheus latency idiom:
+    `le`-labeled monotone bucket counters plus `_sum`/`_count`), so latency
+    SLOs are queryable server-side with histogram_quantile() instead of
+    being frozen into whatever quantiles a Summary exported.
+
+    `quantile()` interpolates linearly inside the winning bucket — kept so
+    call sites that want a quick p50/p95 without a Prometheus server
+    (tools/bench_serve.py) survive the Summary -> Histogram migration."""
+
+    # latency-shaped default: 1ms .. 60s, roughly x2.5 per step
+    DEFAULT_BUCKETS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    )
+
+    def __init__(self, registry, name, help_text,
+                 buckets: tuple[float, ...] | None = None):
+        super().__init__(registry, name, help_text, "histogram")
+        buckets = self.DEFAULT_BUCKETS if buckets is None else tuple(
+            float(b) for b in buckets
+        )
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(
+                f"histogram {name} buckets must be ascending, got {buckets}"
+            )
+        self.buckets = buckets
+        # per-label-set: per-bucket NON-cumulative counts (cumulated at
+        # collect time — one increment per observe, not len(buckets))
+        self._bucket_counts: dict[tuple, list[int]] = {}
+        self._count: dict[tuple, int] = {}
+        self._sum: dict[tuple, float] = {}
+
+    def observe(self, v: float, **labels: str) -> None:
+        v = float(v)
+        key = self._key(labels)
+        with self.registry._lock:
+            counts = self._bucket_counts.get(key)
+            if counts is None:
+                # one slot per finite bucket + the +Inf overflow slot
+                counts = self._bucket_counts[key] = [0] * (len(self.buckets) + 1)
+            # first edge >= v gets the observation (`le` semantics);
+            # v beyond the last finite edge lands in the +Inf slot
+            counts[bisect_left(self.buckets, v)] += 1
+            self._count[key] = self._count.get(key, 0) + 1
+            self._sum[key] = self._sum.get(key, 0.0) + v
+
+    def count(self, **labels: str) -> int:
+        with self.registry._lock:
+            return self._count.get(self._key(labels), 0)
+
+    def sum(self, **labels: str) -> float:
+        with self.registry._lock:
+            return self._sum.get(self._key(labels), 0.0)
+
+    def bucket_counts(self, **labels: str) -> dict[float, int]:
+        """Upper-bound -> CUMULATIVE count (the exposition's view)."""
+        key = self._key(labels)
+        with self.registry._lock:
+            counts = list(self._bucket_counts.get(key, []))
+        out: dict[float, int] = {}
+        running = 0
+        edges = list(self.buckets) + [float("inf")]
+        for edge, n in zip(edges, counts or [0] * len(edges)):
+            running += n
+            out[edge] = running
+        return out
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Histogram-estimated quantile: linear interpolation within the
+        bucket holding rank q*count (lower bound 0 for the first bucket,
+        clamped to the last finite edge for the +Inf bucket). NaN when no
+        observations exist for this label set."""
+        cum = self.bucket_counts(**labels)
+        total = self._count.get(self._key(labels), 0)
+        if not total:
+            return float("nan")
+        rank = q * total
+        prev_edge, prev_cum = 0.0, 0
+        for edge, c in cum.items():
+            if c >= rank and c > prev_cum:
+                if edge == float("inf"):
+                    return self.buckets[-1]
+                frac = (rank - prev_cum) / (c - prev_cum)
+                return prev_edge + frac * (edge - prev_edge)
+            prev_edge, prev_cum = (0.0 if edge == float("inf") else edge), c
+        return self.buckets[-1]
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        for key in sorted(self._bucket_counts):
+            running = 0
+            for edge, n in zip(
+                list(self.buckets) + [float("inf")], self._bucket_counts[key]
+            ):
+                running += n
+                le = "+Inf" if edge == float("inf") else _format_value(edge)
+                blabels = key + (("le", le),)
+                lines.append(
+                    f"{self.name}_bucket{_format_labels(blabels)} {running}"
+                )
+            lines.append(
+                f"{self.name}_sum{_format_labels(key)} "
+                f"{_format_value(self._sum[key])}"
+            )
+            lines.append(
+                f"{self.name}_count{_format_labels(key)} "
+                f"{self._count[key]}"
+            )
+        return lines
 
 
 class Summary(_Family):
@@ -188,6 +303,10 @@ class MetricsRegistry:
         return self._register(
             Summary(self, name, help_text, window=window, quantiles=quantiles)
         )
+
+    def histogram(self, name: str, help_text: str,
+                  buckets: tuple[float, ...] | None = None) -> Histogram:
+        return self._register(Histogram(self, name, help_text, buckets=buckets))
 
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4, trailing newline."""

@@ -1,0 +1,447 @@
+"""Observability subsystem tests (mine_tpu/obs/): span nesting and
+Chrome-trace export, the disabled-path no-op overhead guard, flight-recorder
+dumps on SIGUSR1 and on a simulated stall, MFU math against a jitted matmul
+with known FLOPs, the Histogram metric family, a serving /metrics +
+/debug/trace smoke that never compiles a model, and a short end-to-end
+training run with obs enabled (host trace file parseable by
+tools/profile_summary.py, finite MFU gauge from cost_analysis)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mine_tpu.obs import FlightRecorder, Tracer, compiled_cost, compute_mfu
+from mine_tpu.obs.cost import chip_peak_flops, resolve_peak_flops
+from mine_tpu.obs.trace import HOST_PROCESS_NAME
+from mine_tpu.utils.metrics import MetricsRegistry
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_spans_nest_and_export_valid_chrome_trace(tmp_path):
+    tracer = Tracer(enabled=True, max_spans=64)
+    with tracer.span("outer", cat="test", step=1):
+        time.sleep(0.002)
+        with tracer.span("inner", cat="test"):
+            time.sleep(0.001)
+
+    spans = {s.name: s for s in tracer.snapshot()}
+    assert set(spans) == {"outer", "inner"}
+    inner, outer = spans["inner"], spans["outer"]
+    assert inner.depth == 1 and outer.depth == 0
+    # containment: inner starts after outer and ends before outer ends
+    assert inner.ts_us >= outer.ts_us
+    assert inner.ts_us + inner.dur_us <= outer.ts_us + outer.dur_us + 1.0
+    assert outer.args == {"step": 1}
+
+    path = tracer.export(str(tmp_path / "host_spans.trace.json"))
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M" and e["name"] == "process_name"]
+    assert metas and metas[0]["args"]["name"] == HOST_PROCESS_NAME
+    xs = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert set(xs) == {"outer", "inner"}
+    assert xs["inner"]["dur"] <= xs["outer"]["dur"]
+    assert xs["outer"]["args"] == {"step": 1}
+    assert all(isinstance(e["ts"], float) for e in xs.values())
+
+
+def test_tracer_threads_get_their_own_lanes_and_stacks():
+    tracer = Tracer(enabled=True)
+
+    def worker():
+        with tracer.span("w", cat="t"):
+            pass
+
+    t = threading.Thread(target=worker, name="obs-test-worker")
+    with tracer.span("main", cat="t"):
+        t.start()
+        t.join()
+    by_name = {s.name: s for s in tracer.snapshot()}
+    assert by_name["w"].tid != by_name["main"].tid
+    assert by_name["w"].thread_name == "obs-test-worker"
+    # the worker's span is NOT nested under main's (per-thread stacks)
+    assert by_name["w"].depth == 0
+
+
+def test_tracer_ring_is_bounded_and_counts_drops():
+    tracer = Tracer(enabled=True, max_spans=8)
+    for i in range(20):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer) == 8
+    assert tracer.dropped == 12
+    assert [s.name for s in tracer.snapshot()] == [f"s{i}" for i in range(12, 20)]
+    assert [s.name for s in tracer.snapshot(last_k=2)] == ["s18", "s19"]
+
+
+def test_phase_summary_aggregates_and_resets():
+    tracer = Tracer(enabled=True)
+    for _ in range(3):
+        with tracer.span("step", cat="train"):
+            pass
+    summary = tracer.phase_summary(reset=True)
+    assert summary["train.step"]["count"] == 3
+    assert summary["train.step"]["total_ms"] >= 0.0
+    assert tracer.phase_summary() == {}
+
+
+def test_disabled_tracer_records_nothing_and_is_noop_cheap():
+    """The acceptance guard: tracing default-off must add no measurable
+    per-step host cost. 20µs/call is ~100x the real cost of the disabled
+    path (one attribute check + a shared null context manager) and far
+    below per-step host work — generous enough to never flake, tight
+    enough to catch an accidental allocation-per-span regression."""
+    tracer = Tracer(enabled=False)
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with tracer.span("hot", cat="train", step=1):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert len(tracer) == 0
+    assert tracer.phase_summary() == {}
+    assert per_call < 20e-6, f"disabled span cost {per_call * 1e6:.2f}µs"
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def _flight_files(dump_dir: str) -> list[str]:
+    return sorted(
+        os.path.join(dump_dir, d) for d in os.listdir(dump_dir)
+        if d.startswith("flight_")
+    )
+
+
+def test_flight_recorder_dumps_on_sigusr1(tmp_path):
+    tracer = Tracer(enabled=True)
+    with tracer.span("before_signal", cat="test"):
+        pass
+    fr = FlightRecorder(
+        str(tmp_path), tracer=tracer, last_k_spans=16,
+        min_dump_interval_s=0.0, get_status=lambda: {"phase": "testing"},
+    )
+    fr.start()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        while not fr.dumps and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        fr.stop()
+    assert fr.dumps, "SIGUSR1 produced no dump"
+    dump = fr.dumps[0]
+    stacks = open(os.path.join(dump, "stacks.txt")).read()
+    assert "test_flight_recorder_dumps_on_sigusr1" in stacks
+    spans = json.load(open(os.path.join(dump, "spans.json")))
+    assert [s["name"] for s in spans["spans"]] == ["before_signal"]
+    meta = json.load(open(os.path.join(dump, "meta.json")))
+    assert meta["reason"] == "signal_sigusr1"
+    assert meta["status"] == {"phase": "testing"}
+    # SIGUSR1 must not have killed or disarmed anything: handlers restored
+    assert signal.getsignal(signal.SIGUSR1) in (
+        signal.SIG_DFL, signal.Handlers.SIG_DFL, signal.default_int_handler,
+    ) or callable(signal.getsignal(signal.SIGUSR1))
+
+
+def test_flight_recorder_dumps_on_simulated_stall(tmp_path):
+    """Short watchdog + a 'step' that sleeps past it -> exactly one stall
+    dump containing all-thread stacks and the last-K spans; the heartbeat
+    resuming re-arms the watchdog."""
+    tracer = Tracer(enabled=True)
+    fr = FlightRecorder(
+        str(tmp_path), tracer=tracer, watchdog_timeout_s=0.25,
+        last_k_spans=4, min_dump_interval_s=0.0,
+    )
+    fr.start()
+    try:
+        for i in range(6):
+            with tracer.span("step", cat="train", step=i):
+                pass
+            fr.heartbeat(step=i)
+        # the stalled "step": no heartbeat for > timeout
+        time.sleep(0.9)
+        assert len(fr.dumps) == 1, "stall watchdog should dump exactly once"
+        dump = fr.dumps[0]
+        stacks = open(os.path.join(dump, "stacks.txt")).read()
+        assert "Thread" in stacks or "thread" in stacks
+        assert "test_flight_recorder_dumps_on_simulated_stall" in stacks
+        spans = json.load(open(os.path.join(dump, "spans.json")))
+        assert len(spans["spans"]) == 4  # last-K, not the whole ring
+        assert all(s["name"] == "step" for s in spans["spans"])
+        meta = json.load(open(os.path.join(dump, "meta.json")))
+        assert meta["reason"] == "stall"
+        assert meta["last_step"] == 5
+        assert meta["heartbeat_age_s"] >= 0.25
+        # heartbeat resumes -> watchdog re-arms -> a second stall dumps again
+        fr.heartbeat(step=6)
+        time.sleep(0.6)
+        assert len(fr.dumps) == 2
+    finally:
+        fr.stop()
+
+
+# ----------------------------------------------------------- cost / MFU
+
+
+def test_compiled_cost_matches_known_matmul_flops():
+    """MFU math verified against a jitted matmul with known FLOPs: XLA's
+    cost analysis of (M,K)@(K,N) must report 2*M*N*K."""
+    import jax
+    import jax.numpy as jnp
+
+    m, k, n = 128, 256, 64
+    compiled = (
+        jax.jit(lambda a, b: a @ b)
+        .lower(
+            jnp.ones((m, k), jnp.float32), jnp.ones((k, n), jnp.float32)
+        )
+        .compile()
+    )
+    cost = compiled_cost(compiled)
+    assert cost.flops == 2 * m * n * k
+    assert cost.bytes_accessed and cost.bytes_accessed > 0
+    assert cost.output_bytes == m * n * 4
+
+    # 2*M*N*K flops in 1ms against a 1 TFLOP/s "peak" => MFU exactly known
+    mfu = compute_mfu(cost.flops, 1e-3, 1e12)
+    assert mfu == pytest.approx(2 * m * n * k / 1e-3 / 1e12)
+
+
+def test_mfu_math_none_propagation_and_peak_table():
+    assert compute_mfu(None, 1.0, 1e12) is None
+    assert compute_mfu(1e9, 1.0, None) is None
+    assert compute_mfu(1e9, 0.0, 1e12) is None
+    assert compute_mfu(1e12, 1.0, 1e12) == pytest.approx(1.0)
+    # the published table: exact and prefix matches; unknown kinds are None
+    assert chip_peak_flops("TPU v4") == 275e12
+    assert chip_peak_flops("TPU v4 (podslice)") == 275e12
+    assert chip_peak_flops("cpu") is None
+    assert chip_peak_flops("TPU v5 lite") == 197e12  # not the v5p row
+    # override beats the table; 0 means "use the table"
+    assert resolve_peak_flops(object(), override=5e9) == 5e9
+
+
+# ------------------------------------------------------------- histogram
+
+
+def test_histogram_buckets_monotone_sum_count_and_exposition():
+    r = MetricsRegistry()
+    h = r.histogram(
+        "demo_latency_seconds", "demo", buckets=(0.01, 0.1, 1.0)
+    )
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v, endpoint="render")
+    assert h.count(endpoint="render") == 5
+    assert h.sum(endpoint="render") == pytest.approx(5.605)
+    # cumulative bucket counts are monotone nondecreasing, +Inf == count
+    counts = h.bucket_counts(endpoint="render")
+    assert counts == {0.01: 1, 0.1: 3, 1.0: 4, float("inf"): 5}
+    assert list(counts.values()) == sorted(counts.values())
+
+    text = r.render()
+    assert "# TYPE demo_latency_seconds histogram" in text
+    assert 'demo_latency_seconds_bucket{endpoint="render",le="0.01"} 1' in text
+    assert 'demo_latency_seconds_bucket{endpoint="render",le="0.1"} 3' in text
+    assert 'demo_latency_seconds_bucket{endpoint="render",le="1"} 4' in text
+    assert 'demo_latency_seconds_bucket{endpoint="render",le="+Inf"} 5' in text
+    assert 'demo_latency_seconds_count{endpoint="render"} 5' in text
+    assert 'demo_latency_seconds_sum{endpoint="render"} 5.605' in text
+
+    # interpolated quantiles stay inside the right bucket
+    assert 0.01 <= h.quantile(0.5, endpoint="render") <= 0.1
+    assert h.quantile(0.99, endpoint="render") == pytest.approx(1.0)  # +Inf clamps
+    assert np.isnan(h.quantile(0.5, endpoint="nope"))
+
+    with pytest.raises(ValueError):
+        r.histogram("demo_latency_seconds", "dup", buckets=(1.0, 0.5))
+    with pytest.raises(ValueError):
+        r.counter("demo_latency_seconds", "wrong kind")
+
+
+# ----------------------------------------- serving smoke (no model compile)
+
+
+@pytest.fixture()
+def tiny_serving_app():
+    """A real ServingApp over FAKE weights: the engine only touches params
+    at predict time, so /metrics, /debug/trace, and the request-error path
+    are exercised without a single XLA compile."""
+    from mine_tpu.config import Config
+    from mine_tpu.serving.server import ServingApp, make_server
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "mpi.num_bins_coarse": 4,
+    })
+    app = ServingApp(
+        cfg, params={"w": np.zeros(1, np.float32)}, batch_stats={},
+    )
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield app, f"http://{host}:{port}"
+    finally:
+        server.shutdown()
+        app.close()
+
+
+def _get(base: str, path: str):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def test_serving_metrics_histograms_and_debug_trace(tiny_serving_app):
+    """The CI satellite: /metrics exposes the histogram and trace-counter
+    families and /debug/trace returns parseable Chrome-trace JSON under
+    the stdlib server."""
+    app, base = tiny_serving_app
+
+    # a malformed render generates parse-phase spans and a 400 observation
+    req = urllib.request.Request(base + "/render", data=b"{not json",
+                                 headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+    except urllib.error.HTTPError as err:
+        assert err.code == 400
+
+    status, body = _get(base, "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "# TYPE mine_serve_request_latency_seconds histogram" in text
+    assert "# TYPE mine_serve_queue_delay_seconds histogram" in text
+    assert "# TYPE mine_serve_trace_spans_total counter" in text
+    assert 'mine_serve_request_latency_seconds_bucket{endpoint="render",le="+Inf"} 1' in text
+    # MFU gauge family exists even before any render resolves it
+    assert "# TYPE mine_serve_mfu gauge" in text
+
+    status, body = _get(base, "/debug/trace")
+    assert status == 200
+    doc = json.loads(body)
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert "parse" in names  # the request lifecycle left host spans
+    metas = [e for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert metas[0]["args"]["name"] == HOST_PROCESS_NAME
+
+    # the spans that served THIS trace request also count in the family
+    status, body = _get(base, "/metrics")
+    assert 'mine_serve_trace_spans_total{cat="serve"}' in body.decode()
+
+
+# ------------------------------------------- merged host+device summary
+
+
+def test_profile_summary_merges_host_and_device_traces(tmp_path):
+    """Device trace (jax.profiler-shaped, gzipped) and host trace (the obs
+    tracer's export) in one run dir -> one table with both lanes, each
+    summed against its own lane total."""
+    import gzip
+
+    from tools.profile_summary import summarize
+
+    device_events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0 TensorCore"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 0, "dur": 300.0},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "conv.2", "ts": 300, "dur": 100.0},
+    ]
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    with gzip.open(run / "dev.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": device_events}, fh)
+
+    tracer = Tracer(enabled=True)
+    with tracer.span("step", cat="train"):
+        time.sleep(0.002)
+    tracer.export(str(tmp_path / "host_spans.trace.json"))
+
+    table = summarize(str(tmp_path))
+    assert table["device_lanes"] == ["/device:TPU:0 TensorCore"]
+    assert table["host_lanes"] == [HOST_PROCESS_NAME]
+    lanes = {(r["lane"], r["op"]) for r in table["rows"]}
+    assert {("device", "fusion.1"), ("device", "conv.2"),
+            ("host", "step")} <= lanes
+    # pct is per-lane: device rows sum to 100 regardless of host time
+    dev_pct = sum(r["pct"] for r in table["rows"] if r["lane"] == "device")
+    assert dev_pct == pytest.approx(100.0, abs=0.2)
+
+
+# ------------------------------------- end-to-end training run with obs on
+
+
+def test_training_run_with_obs_writes_trace_mfu_and_flight_armed(tmp_path):
+    """Acceptance: with obs enabled on the CPU mesh, a short training run
+    writes a Chrome-trace span file that tools/profile_summary.py parses
+    (merged host+device table), logs a finite MFU gauge derived from
+    cost_analysis (peak via the explicit CPU override), and leaves the
+    flight recorder armed + disarmed cleanly."""
+    from mine_tpu.config import Config
+    from mine_tpu.data import SyntheticDataset
+    from mine_tpu.training.loop import Trainer
+    from tools.profile_summary import summarize
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": 1,
+        "data.num_workers": 0,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "mpi.num_bins_coarse": 4,
+        "training.epochs": 1,
+        "training.log_interval": 1,
+        "obs.enabled": True,
+        "obs.flight_watchdog_s": 120.0,  # armed but never plausibly fired
+        "obs.peak_flops_override": 1.0e12,  # CPU has no published peak
+    })
+    workspace = str(tmp_path / "ws")
+    ds = SyntheticDataset(128, 128, 8, steps_per_epoch=3)
+    trainer = Trainer(cfg, workspace)
+    trainer.fit(ds)
+
+    # host spans exported as *.trace.json under <workspace>/profile
+    trace_path = os.path.join(workspace, "profile", "host_spans.trace.json")
+    assert os.path.exists(trace_path)
+    doc = json.load(open(trace_path))
+    phases = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"data", "step", "sync", "log", "ckpt"} <= phases
+
+    # tools/profile_summary.py parses it into the host half of its table
+    table = summarize(os.path.join(workspace, "profile"))
+    assert table["host_lanes"], table
+    host_ops = {r["op"] for r in table["rows"] if r["lane"] == "host"}
+    assert {"step", "data", "aot_compile"} <= host_ops
+
+    # a finite MFU scalar derived from cost_analysis reached the writer...
+    tags = {}
+    with open(os.path.join(workspace, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            tags.setdefault(rec["tag"], []).append(rec["value"])
+    assert any(np.isfinite(v) and v > 0 for v in tags["obs/mfu"])
+    assert any(v > 0 for v in tags["obs/step_flops"])
+    # ...and the live gauge on the utils/metrics.py registry agrees
+    assert trainer.obs_metrics.mfu.value() > 0
+    rendered = trainer.obs_metrics.registry.render()
+    assert "# TYPE mine_train_mfu gauge" in rendered
+
+    # per-phase breakdown published at each log interval
+    assert "obs/phase_step_ms" in tags and "obs/phase_data_ms" in tags
+
+    # flight recorder disarmed on exit: handlers restored, watchdog joined
+    assert trainer.flight is not None
+    assert trainer.flight._watchdog is None
+    assert not trainer.flight.dumps  # nothing stalled in a healthy run

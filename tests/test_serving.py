@@ -456,8 +456,36 @@ def test_serving_end_to_end_http(served_workspace):
         assert _metric_value(text, "mine_serve_rendered_frames_total") == 8
         rps = _metric_value(text, "mine_serve_renders_per_sec")
         assert np.isfinite(rps) and rps > 0
-        # latency summary present for both endpoints
-        assert 'mine_serve_request_latency_seconds{endpoint="render"' in text
+        # request latency is a cumulative-bucket histogram now: bucket,
+        # sum, and count series per endpoint, bucket counts monotone
+        assert "# TYPE mine_serve_request_latency_seconds histogram" in text
+        assert ('mine_serve_request_latency_seconds_bucket'
+                '{endpoint="render",le="+Inf"} 8') in text
+        assert 'mine_serve_request_latency_seconds_count{endpoint="render"} 8' in text
+        lat = app.metrics.request_latency
+        bucket_counts = list(lat.bucket_counts(endpoint="render").values())
+        assert bucket_counts == sorted(bucket_counts)  # cumulative/monotone
+        assert np.isfinite(lat.quantile(0.95, endpoint="render"))
+        # queue-delay histogram observed one entry per coalesced request
+        assert _metric_value(
+            text, "mine_serve_queue_delay_seconds_count") == 8
+        # the request-lifecycle tracer counted spans and serves them as
+        # parseable Chrome-trace JSON on /debug/trace
+        assert _metric_value(text, 'mine_serve_trace_spans_total{cat="serve"}') >= 8
+        status, body = _http(base, "/debug/trace")
+        assert status == 200
+        trace_doc = json.loads(body)
+        span_names = {e["name"] for e in trace_doc["traceEvents"]
+                      if e["ph"] == "X"}
+        assert {"parse", "queue_wait", "coalesce", "dispatch",
+                "encode", "predict"} <= span_names
+        # render executables carry XLA cost analysis; on CPU the peak is
+        # unknown so the MFU gauge stays unset (honest absence), but the
+        # achieved-TFLOP/s gauge and FLOPs-per-step gauge are live
+        assert _metric_value(
+            text, 'mine_serve_step_flops{kind="render"}') > 0
+        assert _metric_value(
+            text, "mine_serve_achieved_tflops_per_sec") > 0
 
         # healthz snapshot
         status, body = _http(base, "/healthz")

@@ -39,6 +39,24 @@ RUN_TIMEOUT_S = int(os.environ.get("BENCH_SERVE_RUN_TIMEOUT_S", "1800"))
 
 METRIC = "serve_renders_per_sec"
 
+# bench-side host spans (mine_tpu/obs/): which phase a failed round died
+# in, embedded in the emitted JSON next to the SERVER-side request phases
+from mine_tpu.obs.trace import Tracer  # noqa: E402 - stdlib-only import
+
+_TRACER = Tracer(enabled=True, max_spans=2048)
+_BACKEND_NOTE: str | None = None
+_APP = None  # the live ServingApp, once built — its tracer joins the JSON
+
+
+def _obs_snapshot() -> dict:
+    snap: dict = {
+        "platform_probe": _BACKEND_NOTE,
+        "bench_phases": _TRACER.phase_summary(),
+    }
+    if _APP is not None:
+        snap["server_phases"] = _APP.tracer.phase_summary()
+    return snap
+
 
 def _emit_failure(exc: BaseException) -> None:
     print(json.dumps({
@@ -46,7 +64,9 @@ def _emit_failure(exc: BaseException) -> None:
         "value": None,
         "unit": "imgs/sec",
         "error": f"{type(exc).__name__}: {exc}"[:2000],
-        "note": "serving bench failed before producing a measurement",
+        "obs": _obs_snapshot(),
+        "note": "serving bench failed before producing a measurement; the "
+                "obs payload records which phase died",
     }))
 
 
@@ -94,7 +114,10 @@ def main() -> None:
                     help="serve a trained workspace instead of random init")
     args = ap.parse_args()
 
-    backend_note = _resolve_backend()
+    global _BACKEND_NOTE
+    with _TRACER.span("resolve_backend", cat="bench"):
+        backend_note = _resolve_backend()
+    _BACKEND_NOTE = backend_note
 
     from mine_tpu.utils.platform import honor_jax_platforms
 
@@ -139,17 +162,20 @@ def main() -> None:
         batch_stats = variables.get("batch_stats", {})
         step = 0
 
+    global _APP
     app = ServingApp(
         cfg, params, batch_stats, checkpoint_step=step,
         max_delay_ms=args.max_delay_ms,
     )
+    _APP = app
     t0 = time.perf_counter()
     # warm the pose buckets a coalesced group can land on (capped at the
     # batcher's max batch), so the measurement is steady-state throughput
-    app.engine.warmup(pose_counts=tuple(
-        b for b in app.engine.pose_buckets
-        if b <= app.batcher.max_batch_poses
-    ))
+    with _TRACER.span("warmup_compile", cat="bench"):
+        app.engine.warmup(pose_counts=tuple(
+            b for b in app.engine.pose_buckets
+            if b <= app.batcher.max_batch_poses
+        ))
     compile_s = time.perf_counter() - t0
 
     server = make_server(app)
@@ -187,6 +213,10 @@ def main() -> None:
     errors: list[str] = []
     work = [render_payload(i) for i in range(args.requests)]
     work_lock = threading.Lock()
+    # per-request wall times, measured client-side: exact percentiles for
+    # the JSON (the server's histogram buckets quantize to ~2.5x steps —
+    # fine for live SLO scraping, too coarse for a published bench number)
+    latencies_s: list[float] = []
 
     def client() -> None:
         while True:
@@ -195,20 +225,27 @@ def main() -> None:
                     return
                 payload = work.pop()
             try:
+                t_req = time.perf_counter()
                 s, _ = _http(base, "/render", data=payload,
                              headers={"Content-Type": "application/json"})
+                dt = time.perf_counter() - t_req
                 if s != 200:
                     errors.append(f"status {s}")
+                else:
+                    with work_lock:
+                        latencies_s.append(dt)
             except Exception as exc:  # noqa: BLE001 - collected for the JSON
                 errors.append(f"{type(exc).__name__}: {exc}")
 
     clients = [threading.Thread(target=client)
                for _ in range(args.concurrency)]
     t0 = time.perf_counter()
-    for c in clients:
-        c.start()
-    for c in clients:
-        c.join()
+    with _TRACER.span("measure", cat="bench", requests=args.requests,
+                      concurrency=args.concurrency):
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join()
     elapsed = time.perf_counter() - t0
     if errors:
         raise RuntimeError(
@@ -232,10 +269,8 @@ def main() -> None:
         "poses_per_request": args.poses_per_request,
         "elapsed_s": round(elapsed, 2),
         "compile_s": round(compile_s, 1),
-        "render_p50_ms": round(1e3 * app.metrics.request_latency.quantile(
-            0.5, endpoint="render"), 1),
-        "render_p95_ms": round(1e3 * app.metrics.request_latency.quantile(
-            0.95, endpoint="render"), 1),
+        "render_p50_ms": round(1e3 * float(np.percentile(latencies_s, 50)), 1),
+        "render_p95_ms": round(1e3 * float(np.percentile(latencies_s, 95)), 1),
         "encoder_invocations": _metric_value(
             metrics_text, "mine_serve_encoder_invocations_total"),
         "dispatches": _metric_value(
@@ -243,6 +278,7 @@ def main() -> None:
         "coalesced_dispatches": _metric_value(
             metrics_text, "mine_serve_batch_coalesced_dispatches_total"),
         "backend": backend_note,
+        "obs": _obs_snapshot(),
         "device": jax.devices()[0].device_kind,
         "note": (
             "end-to-end through HTTP (PNG decode/encode + queueing + "

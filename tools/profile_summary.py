@@ -1,19 +1,26 @@
 #!/usr/bin/env python3
-"""Summarize a jax.profiler trace dir into a top-op cost table.
+"""Summarize a trace dir into a merged host+device top-op cost table.
 
-Offline (stdlib-only) reader for the Chrome-trace JSON that
-`jax.profiler.start_trace` writes under
-`<dir>/plugins/profile/<run>/*.trace.json.gz` — no tensorboard profile
-plugin needed, which matters in this no-egress image. Feed it the
-BENCH_PROFILE_DIR a bench run captured (bench.py) or a training
-`--profile-steps` workspace profile.
+Offline (stdlib-only) reader for two kinds of Chrome-trace JSON that land
+in one run directory:
+
+  * the device traces `jax.profiler.start_trace` writes under
+    `<dir>/plugins/profile/<run>/*.trace.json.gz` — no tensorboard profile
+    plugin needed, which matters in this no-egress image;
+  * the host-span traces mine_tpu/obs/trace.py exports as
+    `host_spans.trace.json` (process lane "mine_tpu host spans") —
+    training step phases, serving request phases, bench phases.
+
+Feed it the BENCH_PROFILE_DIR a bench run captured (bench.py) or a
+training `--profile-steps` / obs.enabled workspace profile dir.
 
   python tools/profile_summary.py profiles_r04 [--top 15]
 
-Prints one JSON line per op group (fused-op name, total ms, % of device
-time, call count), device-derived rows only (TensorCore/SparseCore pids),
-sorted by total duration. The table is what BASELINE.md's step-composition
-accounting quotes.
+Prints one JSON header line, then one JSON line per op group (fused-op or
+host-phase name, total ms, % of its lane's time, call count), device rows
+first (TensorCore/SparseCore pids) then host rows, each sorted by total
+duration. The device table is what BASELINE.md's step-composition
+accounting quotes; the host table is what the obs phase breakdown quotes.
 """
 
 from __future__ import annotations
@@ -25,6 +32,10 @@ import json
 import os
 import sys
 from collections import defaultdict
+
+# must match mine_tpu/obs/trace.py HOST_PROCESS_NAME (kept as a literal so
+# this tool stays importable without mine_tpu on the path)
+HOST_LANE_MARKER = "mine_tpu host"
 
 
 def find_traces(root: str) -> list[str]:
@@ -38,10 +49,11 @@ def find_traces(root: str) -> list[str]:
     return sorted(out)
 
 
-def load_events(path: str) -> dict:
+def load_events(path: str) -> list[dict]:
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt") as fh:
-        return json.load(fh)
+        data = json.load(fh)
+    return data.get("traceEvents", data if isinstance(data, list) else [])
 
 
 def device_pids(meta_events: list[dict]) -> dict[int, str]:
@@ -50,30 +62,26 @@ def device_pids(meta_events: list[dict]) -> dict[int, str]:
     for ev in meta_events:
         if ev.get("ph") == "M" and ev.get("name") == "process_name":
             name = ev.get("args", {}).get("name", "")
+            if HOST_LANE_MARKER in name:
+                continue  # "mine_tpu host spans" contains "tpu" — not a device
             if any(k in name.lower() for k in ("tpu", "tensorcore", "device",
                                                "sparsecore", "/device:")):
                 names[ev["pid"]] = name
     return names
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("trace_dir")
-    ap.add_argument("--top", type=int, default=15)
-    args = ap.parse_args()
+def host_pids(meta_events: list[dict]) -> dict[int, str]:
+    """pid -> process name for mine_tpu host-span lanes (obs/trace.py)."""
+    names = {}
+    for ev in meta_events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = ev.get("args", {}).get("name", "")
+            if HOST_LANE_MARKER in name:
+                names[ev["pid"]] = name
+    return names
 
-    traces = find_traces(args.trace_dir)
-    if not traces:
-        print(json.dumps({"error": f"no *.trace.json[.gz] under {args.trace_dir}"}))
-        sys.exit(1)
 
-    # newest run wins (bench reruns append run dirs)
-    data = load_events(traces[-1])
-    events = data.get("traceEvents", data if isinstance(data, list) else [])
-    pids = device_pids(events)
-    if not pids:  # fall back: take every complete event (CPU-only traces)
-        pids = {ev["pid"]: "all" for ev in events if ev.get("ph") == "X"}
-
+def _op_table(events: list[dict], pids: dict[int, str]):
     total_us = 0.0
     by_op: dict[str, list[float]] = defaultdict(list)
     for ev in events:
@@ -82,23 +90,98 @@ def main() -> None:
         dur = float(ev.get("dur", 0.0))
         total_us += dur
         by_op[ev.get("name", "?")].append(dur)
-
     rows = sorted(
         ((name, sum(durs), len(durs)) for name, durs in by_op.items()),
         key=lambda r: -r[1],
     )
-    print(json.dumps({
-        "trace": traces[-1],
-        "device_lanes": sorted(set(pids.values())),
-        "device_total_ms": round(total_us / 1e3, 2),
-    }))
-    for name, tot, n in rows[: args.top]:
-        print(json.dumps({
-            "op": name[:120],
+    return total_us, rows
+
+
+def summarize(trace_dir: str, top: int = 15) -> dict:
+    """One merged host+device summary for a run directory.
+
+    Device and host lanes usually live in DIFFERENT files (jax.profiler
+    writes its own run dirs; the obs tracer exports host_spans.trace.json
+    next to them), so each lane kind independently takes its newest file —
+    "newest run wins" per kind, exactly the old single-kind behavior.
+    """
+    traces = find_traces(trace_dir)
+    if not traces:
+        raise FileNotFoundError(f"no *.trace.json[.gz] under {trace_dir}")
+
+    dev_file = host_file = None
+    dev_pids: dict[int, str] = {}
+    hst_pids: dict[int, str] = {}
+    cache: dict[str, list[dict]] = {}
+    for path in reversed(traces):  # newest (sorted-last) wins per kind
+        events = cache.setdefault(path, load_events(path))
+        if dev_file is None:
+            pids = device_pids(events)
+            if pids:
+                dev_file, dev_pids = path, pids
+        if host_file is None:
+            pids = host_pids(events)
+            if pids:
+                host_file, hst_pids = path, pids
+        if dev_file and host_file:
+            break
+
+    if dev_file is None and host_file is None:
+        # neither lane kind present: fall back to every complete event of
+        # the newest file (bare CPU-only traces with no metadata)
+        dev_file = traces[-1]
+        dev_pids = {
+            ev["pid"]: "all"
+            for ev in cache.setdefault(dev_file, load_events(dev_file))
+            if ev.get("ph") == "X"
+        }
+
+    out: dict = {"rows": []}
+    if dev_file is not None:
+        total_us, rows = _op_table(cache[dev_file], dev_pids)
+        out.update({
+            "trace": dev_file,
+            "device_lanes": sorted(set(dev_pids.values())),
+            "device_total_ms": round(total_us / 1e3, 2),
+        })
+        out["rows"] += [{
+            "op": name[:120], "lane": "device",
             "total_ms": round(tot / 1e3, 2),
             "pct": round(100.0 * tot / total_us, 1) if total_us else None,
             "calls": n,
-        }))
+        } for name, tot, n in rows[:top]]
+    if host_file is not None:
+        total_us, rows = _op_table(cache[host_file], hst_pids)
+        out.update({
+            "host_trace": host_file,
+            "host_lanes": sorted(set(hst_pids.values())),
+            "host_total_ms": round(total_us / 1e3, 2),
+        })
+        out["rows"] += [{
+            "op": name[:120], "lane": "host",
+            "total_ms": round(tot / 1e3, 2),
+            "pct": round(100.0 * tot / total_us, 1) if total_us else None,
+            "calls": n,
+        } for name, tot, n in rows[:top]]
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=15)
+    args = ap.parse_args()
+
+    try:
+        table = summarize(args.trace_dir, top=args.top)
+    except FileNotFoundError as exc:
+        print(json.dumps({"error": str(exc)}))
+        sys.exit(1)
+
+    rows = table.pop("rows")
+    print(json.dumps(table))
+    for row in rows:
+        print(json.dumps(row))
 
 
 if __name__ == "__main__":
