@@ -44,6 +44,10 @@ class DataConfig:
     visible_point_count: int = 256
     # host-side loader prefetch depth; 0 = fully synchronous
     num_workers: int = 4
+    # bounded retries (exponential backoff + jitter) for transient per-batch
+    # loader/staging errors before the pipeline re-raises; 0 = fail fast
+    # (data/pipeline.py prefetch)
+    loader_retries: int = 0
 
 
 @dataclass(frozen=True)
@@ -158,6 +162,48 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (mine_tpu/resilience/; no reference analog —
+    the reference silently trains through NaNs and loses everything since
+    the last periodic checkpoint on preemption, SURVEY.md §5.3)."""
+
+    # training sentinel policy when a non-finite loss/grad-norm or a loss
+    # spike is detected: "off" (the reference's behavior), "skip" (the
+    # jitted step drops the poisoned update in-graph and training
+    # continues), "rollback" (restore the last-good checkpoint and rebuild
+    # the data iterator at that position), "abort" (raise). Any policy
+    # other than "off" enables the in-graph isfinite update mask, so
+    # params can never absorb a non-finite update.
+    sentinel_policy: str = "off"
+    # loss-spike trip: host loss > spike_factor * running median of the
+    # last spike_window log-interval losses (after spike_min_history
+    # samples). 0.0 disables spike detection (finiteness stays checked).
+    sentinel_spike_factor: float = 0.0
+    sentinel_spike_window: int = 32
+    sentinel_spike_min_history: int = 5
+    # rollbacks allowed per fit() before the sentinel escalates to abort
+    max_rollbacks: int = 2
+    # SIGTERM/SIGUSR2 trigger an out-of-band atomic checkpoint save before
+    # the flight recorder's dump-then-terminate runs (training/loop.py)
+    preempt_save: bool = True
+    # serving admission control: pending render requests beyond this bound
+    # are shed with HTTP 503 + Retry-After instead of queuing unboundedly
+    # (0 = unbounded, the pre-resilience behavior)
+    serve_max_queue_requests: int = 64
+    # Retry-After seconds suggested on queue-full 503s
+    serve_retry_after_s: float = 1.0
+    # default per-request deadline propagated into the micro-batcher;
+    # requests still queued past it are dropped with 504 before dispatch.
+    # Clamped to the server's request_timeout_s ceiling.
+    serve_deadline_s: float = 30.0
+    # circuit breaker: consecutive engine failures before the serving
+    # breaker opens (0 disables the breaker)
+    breaker_failure_threshold: int = 5
+    # seconds the breaker stays open before half-opening for one trial
+    breaker_reset_s: float = 30.0
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout (TPU-native; no reference analog — the reference's
     only axis is NCCL data-parallel process count, train.py:66)."""
@@ -176,6 +222,7 @@ class Config:
     training: TrainingConfig = field(default_factory=TrainingConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def replace(self, **dot_key_values: Any) -> "Config":
         """Functional update by dot-keys: cfg.replace(**{"mpi.num_bins_coarse": 8})."""

@@ -8,13 +8,43 @@ the consumer, and the device transfer (shard_batch / device_put) runs inside
 that thread too, so H2D copies overlap the previous step's compute
 (double-buffering at depth >= 1). depth <= 0 degrades to the reference's
 synchronous behavior.
+
+Transient-fault containment (`data.loader_retries`): a flaky network
+filesystem or a GC-paused storage daemon should cost one retried batch, not
+the whole epoch. Two stages are covered, both with exponential backoff +
+jitter on transient errors (TransientLoaderError, ChaosFault, OSError,
+TimeoutError), re-raising only after `retries` attempts, with `on_retry`
+ticking the caller's counter per attempt:
+
+  * the per-item stage — the optional chaos seam plus the `transfer`
+    callable — always;
+  * the source-iterator PULL (`next()`), only when the iterable declares
+    `retry_safe_iter = True`. The opt-in is load-bearing: a Python
+    generator closes on raise, so re-pulling a dead generator returns
+    StopIteration and would silently TRUNCATE the epoch — only loaders
+    whose `__next__` does independent per-batch work (e.g. per-batch
+    image reads) may claim the flag. Generators' exceptions still relay
+    to the consumer on the first failure.
 """
 
 from __future__ import annotations
 
 import queue
+import random
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator
+
+from mine_tpu.resilience import chaos
+
+
+class TransientLoaderError(RuntimeError):
+    """A loader error worth retrying (the pipeline's opt-in marker)."""
+
+
+# what the bounded retry treats as transient; anything else re-raises at
+# the consumer immediately (a shape bug retried 3 times is 3x the noise)
+_RETRYABLE = (TransientLoaderError, chaos.ChaosFault, OSError, TimeoutError)
 
 
 class _End:
@@ -26,19 +56,80 @@ class _Raised:
         self.exc = exc
 
 
+def _retrying(
+    fn: Callable[[], Any],
+    retries: int,
+    retry_base_delay_s: float,
+    on_retry: Callable[[int, BaseException], None] | None,
+) -> Any:
+    """Call fn() with bounded transient-error retry + backoff/jitter."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except _RETRYABLE as exc:
+            if attempt >= retries:
+                raise
+            # exponential backoff with jitter: correlated retries from
+            # many hosts must not re-stampede the storage that just
+            # buckled (the classic thundering-herd discipline)
+            delay = retry_base_delay_s * (2.0 ** attempt)
+            delay *= 1.0 + 0.25 * random.random()
+            attempt += 1
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            time.sleep(delay)
+
+
 def prefetch(
     iterable: Iterable[Any],
     depth: int,
     transfer: Callable[[Any], Any] | None = None,
+    retries: int = 0,
+    retry_base_delay_s: float = 0.05,
+    on_retry: Callable[[int, BaseException], None] | None = None,
+    fault_seam: str | None = None,
 ) -> Iterator[Any]:
     """Yield items of `iterable`, produced (and `transfer`ed) up to `depth`
     items ahead on a background thread. Exceptions from the producer re-raise
-    at the consumer's next pull. If the consumer abandons the generator early,
-    the producer thread is unblocked and exits (daemon either way)."""
+    at the consumer's next pull — after `retries` bounded retries of the
+    per-item stage for transient errors (module docstring). `fault_seam`
+    names the chaos seam consulted once per produced item
+    (resilience/chaos.py; None = no seam on this stage). If the consumer
+    abandons the generator early, the producer thread is unblocked and
+    exits (daemon either way)."""
+
+    def produce(item: Any) -> Any:
+        def stage():
+            if fault_seam is not None:
+                chaos.maybe_raise(fault_seam)
+            return transfer(item) if transfer is not None else item
+
+        return _retrying(stage, retries, retry_base_delay_s, on_retry)
+
+    # pull-retry only for iterables that declare their __next__ re-callable
+    # after a failure (module docstring: a dead generator would truncate)
+    pull_retries = (
+        retries if getattr(iterable, "retry_safe_iter", False) else 0
+    )
+    src = iter(iterable)
+    _END_PULL = object()
+
+    def pull() -> Any:
+        def one():
+            try:
+                return next(src)
+            except StopIteration:
+                return _END_PULL
+
+        return _retrying(one, pull_retries, retry_base_delay_s, on_retry)
+
     if depth <= 0:
-        for item in iterable:
-            yield transfer(item) if transfer is not None else item
-        return
+        while True:
+            item = pull()
+            if item is _END_PULL:
+                return
+            yield produce(item)
 
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
@@ -55,11 +146,13 @@ def prefetch(
 
     def worker() -> None:
         try:
-            for item in iterable:
-                out = transfer(item) if transfer is not None else item
-                if not put_or_stop(out):
+            while True:
+                item = pull()
+                if item is _END_PULL:
+                    put_or_stop(_End())
                     return
-            put_or_stop(_End())
+                if not put_or_stop(produce(item)):
+                    return
         except BaseException as exc:  # noqa: BLE001 - relayed to the consumer
             put_or_stop(_Raised(exc))
 

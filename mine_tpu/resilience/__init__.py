@@ -1,0 +1,41 @@
+"""Fault-tolerance layer: detect, degrade, recover.
+
+Four pieces, wired through training, data, serving, and checkpointing:
+
+  sentinel.py  training sentinel — per-step finiteness (in-graph update
+               mask) + loss-spike detection, with skip/rollback/abort
+               policies (`resilience.sentinel_policy`).
+  preempt.py   SIGTERM/SIGUSR2 out-of-band atomic checkpoint save, chained
+               ahead of the flight recorder's dump-then-terminate.
+  breaker.py   serving circuit breaker (closed/open/half-open) behind the
+               admission-controlled micro-batcher.
+  chaos.py     deterministic fault injection ($MINE_TPU_FAULTS) at named
+               seams — the harness the tier-1 tests and
+               tools/chaos_drill.py drive, so every behavior above is
+               provable on CPU.
+
+Import-light on purpose: nothing here touches jax at import time (chaos
+seams sit on serving/data hot paths that must stay cheap when disabled).
+"""
+
+from mine_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from mine_tpu.resilience.chaos import ChaosFault, PreemptedError
+from mine_tpu.resilience.preempt import PreemptionGuard
+from mine_tpu.resilience.sentinel import (
+    SentinelAbort,
+    SentinelRollback,
+    SentinelTrip,
+    TrainingSentinel,
+)
+
+__all__ = [
+    "BreakerOpen",
+    "ChaosFault",
+    "CircuitBreaker",
+    "PreemptedError",
+    "PreemptionGuard",
+    "SentinelAbort",
+    "SentinelRollback",
+    "SentinelTrip",
+    "TrainingSentinel",
+]

@@ -1,0 +1,147 @@
+"""Circuit breaker for the serving engine.
+
+When the device backend starts failing (dead TPU tunnel — the r1–r5
+pattern — OOM loops, a poisoned executable), every queued request riding
+into the engine costs a full dispatch timeout and returns a 500. The
+breaker converts that failure mode into fast, honest load shedding:
+
+  closed     normal operation; consecutive failures are counted.
+  open       `failure_threshold` consecutive failures tripped it: requests
+             are rejected immediately (HTTP 503 + Retry-After) without
+             touching the engine, /healthz reports degraded.
+  half-open  after `reset_after_s` the next `allow()` admits exactly ONE
+             trial request; its success closes the breaker, its failure
+             re-opens it (timer restarts).
+
+Thread-safe; time is injectable for deterministic tests. State changes are
+reported through `on_state` (a gauge hook: 0 closed, 1 half-open, 2 open)
+and trips through `on_trip` (a counter hook).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class BreakerOpen(RuntimeError):
+    """Rejected because the breaker is open (maps to HTTP 503)."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"circuit breaker open; retry after {retry_after_s:.1f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        reset_after_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_state: Callable[[int], None] | None = None,
+        on_trip: Callable[[], None] | None = None,
+    ):
+        if failure_threshold < 0:
+            raise ValueError(f"failure_threshold must be >= 0, got "
+                             f"{failure_threshold}")
+        # threshold 0 disables the breaker entirely (allow() is always True)
+        self.failure_threshold = int(failure_threshold)
+        self.reset_after_s = float(reset_after_s)
+        self._clock = clock
+        self._on_state = on_state
+        self._on_trip = on_trip
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._trial_inflight = False
+        self.trips = 0
+        if on_state is not None:
+            on_state(STATE_CODES[CLOSED])
+
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _set_state_locked(self, state: str) -> None:
+        self._state = state
+        if self._on_state is not None:
+            self._on_state(STATE_CODES[state])
+
+    def _maybe_half_open_locked(self) -> None:
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.reset_after_s):
+            self._set_state_locked(HALF_OPEN)
+            self._trial_inflight = False
+
+    def retry_after_s(self) -> float:
+        """Seconds until the breaker half-opens (0 when not open)."""
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(
+                0.0, self.reset_after_s - (self._clock() - self._opened_at)
+            )
+
+    # -- admission ------------------------------------------------------------
+
+    def rejecting(self) -> bool:
+        """Pure admission probe: True while open (before the reset timer).
+        Does NOT consume the half-open trial slot — use at enqueue time so
+        the trial is spent by the dispatch-time `allow()`, not by admission.
+        """
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state == OPEN
+
+    def allow(self) -> bool:
+        """Dispatch-time gate. In half-open state admits exactly one trial
+        at a time; the trial's record_success/record_failure decides."""
+        if self.failure_threshold == 0:
+            return True
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._trial_inflight:
+                self._trial_inflight = True
+                return True
+            return False
+
+    # -- outcomes -------------------------------------------------------------
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._trial_inflight = False
+            if self._state != CLOSED:
+                self._set_state_locked(CLOSED)
+
+    def record_failure(self) -> None:
+        if self.failure_threshold == 0:
+            return
+        with self._lock:
+            self._consecutive_failures += 1
+            self._trial_inflight = False
+            should_trip = (
+                self._state == HALF_OPEN
+                or (self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold)
+            )
+            if should_trip:
+                self._opened_at = self._clock()
+                if self._state != OPEN:
+                    self.trips += 1
+                    if self._on_trip is not None:
+                        self._on_trip()
+                self._set_state_locked(OPEN)

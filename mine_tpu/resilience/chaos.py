@@ -1,0 +1,203 @@
+"""Deterministic fault injection at named seams (`MINE_TPU_FAULTS`).
+
+Every behavior the resilience layer promises — sentinel skip/rollback,
+preemption-safe save/resume, loader retry, breaker trip/recovery — must be
+provable on CPU without real hardware faults. This module is the one
+injection mechanism all of them share: the production code calls a seam
+(`maybe_raise("loader_raise")`, `should("nan_loss", at=step)`) that is a
+single `is None` check when no schedule is installed, and the tests / the
+chaos drill (tools/chaos_drill.py) install a schedule that fires each fault
+exactly once at a deterministic point.
+
+Grammar (comma-separated, whitespace-free):
+
+    MINE_TPU_FAULTS = fault ("," fault)*
+    fault           = kind "@" counter "=" int
+
+e.g. ``nan_loss@step=7,loader_raise@batch=3,engine_raise@render=2,
+sigterm@step=11``. The counter name is part of the grammar so a spec reads
+as a sentence; it must match the kind's canonical counter (below) — a
+mismatch is a parse error, not a silently dead fault.
+
+Kinds and their seams:
+
+  nan_loss@step=N      training/loop.py poisons step N's batch with NaNs
+                       (the fault flows through the real loss/grad graph).
+  spike_loss@step=N    resilience/sentinel.py inflates the observed host
+                       loss at step N (observation-level: a genuine spike
+                       cannot be induced deterministically from data).
+  sigterm@step=N       training/loop.py SIGTERMs its own process after
+                       completing step N (preemption).
+  sigusr2@step=N       same, SIGUSR2 (out-of-band save-and-continue).
+  preempt_exit@step=N  training/loop.py raises PreemptedError after step N:
+                       the in-process stand-in for a preemption that the
+                       emergency-checkpoint path must absorb (tier-1 tests
+                       cannot let a real SIGTERM kill the test runner).
+  loader_raise@batch=N data/pipeline.py raises a transient ChaosFault on
+                       the Nth produced batch (proves the bounded retry).
+  engine_raise@render=N  serving/engine.py raises on the Nth render
+                       dispatch (proves breaker trip + 500-not-hang).
+  predict_raise@predict=N  serving/engine.py raises on the Nth predict.
+
+Two trigger styles share one `should()` call: value-keyed kinds (counter
+`step`) fire when the caller's `at=` equals the trigger; invocation-keyed
+kinds (`batch`/`render`/`predict`) keep an internal per-kind call count and
+fire when it reaches the trigger. Each configured fault fires ONCE —
+retries and replays after a rollback do not re-fire it, which is exactly
+the transient-fault model the recovery paths exist for.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+ENV_VAR = "MINE_TPU_FAULTS"
+
+# kind -> canonical counter name; value-keyed kinds use counter "step"
+KINDS: dict[str, str] = {
+    "nan_loss": "step",
+    "spike_loss": "step",
+    "sigterm": "step",
+    "sigusr2": "step",
+    "preempt_exit": "step",
+    "loader_raise": "batch",
+    "engine_raise": "render",
+    "predict_raise": "predict",
+}
+_VALUE_KEYED = frozenset(k for k, c in KINDS.items() if c == "step")
+
+
+class ChaosFault(RuntimeError):
+    """The injected fault. Transient by construction (fires once), so retry
+    paths treat it as retryable; non-retry paths see an ordinary error."""
+
+    def __init__(self, kind: str, trigger: int):
+        super().__init__(
+            f"injected chaos fault {kind}@{KINDS[kind]}={trigger} "
+            f"({ENV_VAR} schedule)"
+        )
+        self.kind = kind
+        self.trigger = trigger
+
+
+class PreemptedError(RuntimeError):
+    """In-process preemption stand-in (`preempt_exit@step=N`): unwinds the
+    training loop through the emergency-checkpoint path without a signal."""
+
+
+@dataclass
+class _Fault:
+    kind: str
+    trigger: int
+    fired: bool = False
+
+
+@dataclass
+class ChaosSchedule:
+    """A parsed fault schedule. Thread-safe: seams fire from the training
+    main thread, the prefetch worker, and the batcher worker."""
+
+    spec: str
+    faults: list[_Fault] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        for part in filter(None, self.spec.replace(" ", "").split(",")):
+            try:
+                kind_at, value = part.split("=", 1)
+                kind, counter = kind_at.split("@", 1)
+                trigger = int(value)
+            except ValueError:
+                raise ValueError(
+                    f"bad {ENV_VAR} fault {part!r}: expected kind@counter=int"
+                ) from None
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown {ENV_VAR} fault kind {kind!r} "
+                    f"(known: {sorted(KINDS)})"
+                )
+            if counter != KINDS[kind]:
+                raise ValueError(
+                    f"{ENV_VAR} fault {kind!r} counts {KINDS[kind]!r}, "
+                    f"not {counter!r}"
+                )
+            if trigger < 1:
+                raise ValueError(f"{ENV_VAR} trigger must be >= 1: {part!r}")
+            self.faults.append(_Fault(kind, trigger))
+
+    def should(self, kind: str, at: int | None = None) -> bool:
+        """True exactly once per configured (kind, trigger) match.
+
+        Value-keyed kinds require `at` (the caller's own counter, e.g. the
+        global step); invocation-keyed kinds count calls to this method.
+        """
+        if kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {kind!r}")
+        with self._lock:
+            if at is None:
+                if kind in _VALUE_KEYED:
+                    raise ValueError(f"chaos kind {kind!r} needs at=<step>")
+                self._counts[kind] = at = self._counts.get(kind, 0) + 1
+            for f in self.faults:
+                if f.kind == kind and not f.fired and f.trigger == at:
+                    f.fired = True
+                    return True
+        return False
+
+    def pending(self) -> list[str]:
+        """Unfired faults, for end-of-drill assertions ("did every
+        configured fault actually reach its seam?")."""
+        with self._lock:
+            return [
+                f"{f.kind}@{KINDS[f.kind]}={f.trigger}"
+                for f in self.faults if not f.fired
+            ]
+
+
+_UNPARSED = object()
+_active: ChaosSchedule | None | object = _UNPARSED
+_active_lock = threading.Lock()
+
+
+def active() -> ChaosSchedule | None:
+    """The process-wide schedule: parsed from $MINE_TPU_FAULTS on first
+    call, None when unset/empty. `install()`/`uninstall()` override (tests)."""
+    global _active
+    if _active is _UNPARSED:
+        with _active_lock:
+            if _active is _UNPARSED:
+                spec = os.environ.get(ENV_VAR, "")
+                _active = ChaosSchedule(spec) if spec else None
+    return _active  # type: ignore[return-value]
+
+
+def install(spec: str) -> ChaosSchedule:
+    """Install a schedule programmatically (tests); returns it."""
+    global _active
+    with _active_lock:
+        _active = ChaosSchedule(spec)
+        return _active
+
+
+def uninstall() -> None:
+    """Drop any schedule; the next active() re-reads the environment."""
+    global _active
+    with _active_lock:
+        _active = _UNPARSED
+
+
+def should(kind: str, at: int | None = None) -> bool:
+    """Module-level seam: False (one attribute check) with no schedule."""
+    schedule = active()
+    return schedule.should(kind, at) if schedule is not None else False
+
+
+def maybe_raise(kind: str, at: int | None = None) -> None:
+    """Raise ChaosFault when the schedule says this seam fires now."""
+    schedule = active()
+    if schedule is not None and schedule.should(kind, at):
+        trigger = at if at is not None else schedule._counts[kind]
+        raise ChaosFault(kind, trigger)

@@ -1,0 +1,87 @@
+"""Preemption guard: an out-of-band checkpoint save on SIGTERM/SIGUSR2.
+
+TPU preemptions deliver SIGTERM with a short grace window; everything since
+the last periodic checkpoint is lost unless the process saves NOW. The
+guard installs handlers that run the caller's `save_fn` first and then
+CHAIN to whatever handler was installed before it:
+
+  * Installed after the flight recorder (obs/flight.py), the SIGTERM order
+    becomes: atomic checkpoint save -> flight dump -> re-delivered SIGTERM
+    with the original disposition (termination semantics unchanged — the
+    save and the evidence are the only additions).
+  * With no previous Python handler, SIGTERM still terminates (the default
+    disposition is restored and the signal re-delivered); SIGUSR2 becomes
+    save-and-continue (its default disposition — terminate — is NOT
+    chained: an operator poking a live run for a checkpoint must not kill
+    it).
+
+CPython runs signal handlers on the main thread between bytecodes, so the
+save interrupts the step loop at a safe host point; the device-side step in
+flight is untouched (the loop's `_live_state` is the last COMPLETED step).
+`save_fn` failures are logged, never raised — a broken save must not block
+termination.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, Callable
+
+
+class PreemptionGuard:
+    def __init__(
+        self,
+        save_fn: Callable[[str], None],
+        logger: Any = None,
+        signals: tuple[int, ...] = (signal.SIGTERM, signal.SIGUSR2),
+    ):
+        self.save_fn = save_fn
+        self.logger = logger
+        self._signals = signals
+        self._prev: dict[int, Any] = {}
+        self.triggered: list[str] = []  # signal names handled, oldest first
+
+    def install(self) -> "PreemptionGuard":
+        """Install handlers (main thread only — CPython's rule); no-op off
+        the main thread so library use inside tests/workers stays safe."""
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self._signals:
+            try:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):  # exotic platform / nested ctx
+                pass
+        return self
+
+    def uninstall(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+    def _on_signal(self, signum: int, frame: Any) -> None:
+        name = signal.Signals(signum).name
+        self.triggered.append(name)
+        try:
+            self.save_fn(f"signal_{name.lower()}")
+        except BaseException:  # noqa: BLE001 - never block termination
+            if self.logger is not None:
+                self.logger.exception("preemption save failed (%s)", name)
+        prev = self._prev.get(signum)
+        if callable(prev):
+            # chain (e.g. the flight recorder's dump-then-terminate)
+            prev(signum, frame)
+        elif signum == signal.SIGTERM:
+            # no Python handler underneath: termination must still
+            # terminate — restore the original disposition and re-deliver
+            signal.signal(
+                signum, prev if prev is not None else signal.SIG_DFL
+            )
+            os.kill(os.getpid(), signum)
+        # SIGUSR2 with no previous handler: save-and-continue by design

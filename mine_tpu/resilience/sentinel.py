@@ -1,0 +1,213 @@
+"""Training sentinel: detect a poisoned run and apply a recovery policy.
+
+The reference trains blind: a single non-finite loss silently corrupts the
+params and every step after it is wasted work (SURVEY.md §5.3 lists no
+containment at all). The sentinel closes that with two detectors and three
+policies (`resilience.sentinel_policy`):
+
+Detectors
+  finiteness  every train step computes `isfinite(loss) & isfinite(|grad|)`
+              in-graph (training/step.py) and — for any policy other than
+              "off" — MASKS the update in the same XLA program, so params
+              provably never absorb a non-finite update. The per-step flag
+              is a scalar the loop hands to `observe()` WITHOUT a device
+              sync; flags resolve in one batched device_get at each log
+              interval / checkpoint boundary, keeping steps fully async.
+  spike       the host loss (already fetched each log interval) against
+              `spike_factor` x the running median of the last
+              `spike_window` samples, after `spike_min_history` samples.
+
+Policies on a trip
+  skip      count it and continue — the in-graph mask already dropped the
+            poisoned update(s).
+  rollback  raise SentinelRollback; the training loop restores the
+            last-good checkpoint (training/checkpoint.py `last_good`
+            pointer) and rebuilds the data iterator at that position.
+            Bounded by `resilience.max_rollbacks`, then escalates to abort.
+  abort     raise SentinelAbort (the emergency-checkpoint path persists the
+            last completed step on the way out).
+
+Every trip emits a flight-recorder dump (obs/flight.py) and ticks the
+`mine_train_sentinel_*` counter family on the training metrics registry.
+"""
+
+from __future__ import annotations
+
+import statistics
+from collections import deque
+from typing import Any
+
+from mine_tpu.resilience import chaos
+
+POLICIES = ("off", "skip", "rollback", "abort")
+
+
+class SentinelTrip(RuntimeError):
+    """Base of the raising sentinel outcomes."""
+
+
+class SentinelRollback(SentinelTrip):
+    """Restore last-good and re-seed the data iterator (caught by the
+    training loop's rollback driver)."""
+
+
+class SentinelAbort(SentinelTrip):
+    """Unrecoverable by policy: stop training (emergency checkpoint runs)."""
+
+
+class TrainingSentinel:
+    def __init__(
+        self,
+        res_cfg: Any,  # ResilienceConfig
+        registry: Any,  # utils.metrics.MetricsRegistry
+        logger: Any,
+        flight: Any | None = None,  # obs.FlightRecorder
+    ):
+        if res_cfg.sentinel_policy not in POLICIES:
+            raise ValueError(
+                f"resilience.sentinel_policy={res_cfg.sentinel_policy!r} "
+                f"must be one of {POLICIES}"
+            )
+        self.policy = res_cfg.sentinel_policy
+        self.spike_factor = float(res_cfg.sentinel_spike_factor)
+        self.spike_min_history = int(res_cfg.sentinel_spike_min_history)
+        self.logger = logger
+        self.flight = flight
+        self._pending: list[tuple[int, Any]] = []  # (step, device flag)
+        # a bad vet() verdict (non-raising, signal-handler context) parks
+        # here until the next check() applies the policy
+        self._deferred_reason: str | None = None
+        self._history: deque[float] = deque(
+            maxlen=max(int(res_cfg.sentinel_spike_window), 1)
+        )
+        self.nonfinite_steps = registry.counter(
+            "mine_train_sentinel_nonfinite_steps_total",
+            "train steps whose loss or grad-norm was non-finite",
+        )
+        self.skipped_updates = registry.counter(
+            "mine_train_sentinel_skipped_updates_total",
+            "optimizer updates dropped in-graph by the finiteness mask",
+        )
+        self.trips = registry.counter(
+            "mine_train_sentinel_trips_total",
+            "sentinel trips by reason (nonfinite|spike) and action",
+        )
+        self.rollbacks = registry.counter(
+            "mine_train_sentinel_rollbacks_total",
+            "last-good checkpoint restores triggered by the sentinel",
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy != "off"
+
+    # -- per-step (async; no device sync) -------------------------------------
+
+    def observe(self, step: int, skipped_flag: Any) -> None:
+        """Queue one step's in-graph nonfinite/skip flag (a device scalar:
+        1.0 = the update was non-finite and masked) for the next check()."""
+        if self.enabled and skipped_flag is not None:
+            self._pending.append((step, skipped_flag))
+
+    # -- log-interval / checkpoint-boundary -----------------------------------
+
+    def _resolve_flags(self) -> str | None:
+        """Fetch queued flags in one device_get; tick counters; return
+        "nonfinite" when any step's update was masked (never raises)."""
+        if not self._pending:
+            return None
+        import jax
+
+        flags = jax.device_get([flag for _, flag in self._pending])
+        bad = [s for (s, _), v in zip(self._pending, flags)
+               if float(v) > 0.0]
+        self._pending.clear()
+        if not bad:
+            return None
+        self.nonfinite_steps.inc(len(bad))
+        self.skipped_updates.inc(len(bad))
+        self.logger.warning(
+            "sentinel: non-finite loss/grad at step%s %s — update%s "
+            "dropped in-graph",
+            "s" if len(bad) > 1 else "", bad,
+            "s" if len(bad) > 1 else "",
+        )
+        return "nonfinite"
+
+    def vet(self, step: int) -> bool:
+        """Signal-handler-safe vetting (preemption saves): resolve pending
+        flags WITHOUT raising; True = clean, safe to bless as last-good.
+        A bad verdict is deferred to the next check(), so a SIGUSR2
+        save-and-continue still trips the configured policy afterwards."""
+        if not self.enabled:
+            return True
+        reason = self._resolve_flags()
+        if reason is not None:
+            self._deferred_reason = reason
+            return False
+        return self._deferred_reason is None
+
+    def check(self, host_loss: float | None, step: int) -> None:
+        """Resolve pending flags and spike-check the host loss; raises
+        SentinelRollback/SentinelAbort per policy. host_loss=None is a
+        flags-only flush (checkpoint boundaries, epoch ends)."""
+        if not self.enabled:
+            return
+        reason, self._deferred_reason = self._deferred_reason, None
+        reason = self._resolve_flags() or reason
+        if host_loss is not None:
+            import math
+
+            if chaos.should("spike_loss", at=step):
+                # observation-level injection: a deterministic genuine spike
+                # cannot be induced from data alone (chaos.py docstring)
+                host_loss = host_loss * max(self.spike_factor, 1.0) * 100.0
+            if not math.isfinite(host_loss):
+                reason = reason or "nonfinite"
+            else:
+                if (reason is None and self.spike_factor > 0
+                        and len(self._history) >= self.spike_min_history):
+                    median = statistics.median(self._history)
+                    if median > 0 and host_loss > self.spike_factor * median:
+                        reason = "spike"
+                        self.logger.warning(
+                            "sentinel: loss spike at step %d: %.4g > %.3g x "
+                            "median %.4g", step, host_loss,
+                            self.spike_factor, median,
+                        )
+                if reason is None:
+                    # poisoned samples stay out of the median baseline
+                    self._history.append(host_loss)
+        if reason is not None:
+            self._trip(reason, step, host_loss)
+
+    def flush(self, step: int) -> None:
+        """Flags-only check (no host loss) — checkpoint/epoch boundaries."""
+        self.check(None, step)
+
+    # -- trip -----------------------------------------------------------------
+
+    def _trip(self, reason: str, step: int, host_loss: float | None) -> None:
+        action = self.policy
+        self.trips.inc(reason=reason, action=action)
+        if self.flight is not None:
+            self.flight.dump(
+                f"sentinel_{reason}",
+                extra={"sentinel_step": step, "sentinel_loss": host_loss,
+                       "sentinel_action": action},
+            )
+        msg = (f"sentinel trip at step {step}: reason={reason} "
+               f"action={action} loss={host_loss}")
+        if action == "rollback":
+            raise SentinelRollback(msg)
+        if action == "abort":
+            raise SentinelAbort(msg)
+        self.logger.warning("%s (continuing)", msg)
+
+    def reset_after_rollback(self) -> None:
+        """Drop flags queued before the restore and restart the spike
+        baseline (the restored regime's losses differ from the poisoned
+        run's tail)."""
+        self._pending.clear()
+        self._history.clear()
+        self._deferred_reason = None

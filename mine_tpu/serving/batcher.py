@@ -17,6 +17,15 @@ left in place and seed later groups — coalescing never reorders work within
 a key, and a cold key cannot be starved by a hot one for longer than the
 hot group's dispatch). Results come back through per-request futures, so
 HTTP handler threads just block on their own future with a timeout.
+
+Admission control (resilience PR): the pending deque is BOUNDED —
+`max_queue_requests` beyond-capacity submissions raise QueueFull (HTTP 503
++ Retry-After) instead of queuing work no one will wait for; each request
+carries an optional monotonic `deadline`, and requests still pending past
+it are failed with DeadlineExceeded (HTTP 504) *before* dispatch, so an
+overloaded server never spends device time rendering frames whose client
+already gave up. `stop()` fails stranded requests with the typed
+BatcherStopped so graceful drain maps to 503, not a generic 500.
 """
 
 from __future__ import annotations
@@ -37,13 +46,42 @@ from mine_tpu.serving.cache import CacheKey, MPIEntry
 RenderFn = Callable[[MPIEntry, np.ndarray], tuple[np.ndarray, np.ndarray]]
 
 
+class BatcherStopped(RuntimeError):
+    """The batcher is stopped (shutdown drain) — maps to HTTP 503."""
+
+    def __init__(self) -> None:
+        super().__init__("batcher stopped")
+
+
+class QueueFull(RuntimeError):
+    """Pending queue at capacity — shed with HTTP 503 + Retry-After."""
+
+    def __init__(self, depth: int, bound: int):
+        super().__init__(
+            f"render queue full ({depth} pending >= bound {bound})"
+        )
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request expired while queued; dropped before dispatch (HTTP 504)."""
+
+    def __init__(self, waited_s: float):
+        super().__init__(
+            f"request deadline exceeded after {waited_s:.3f}s in queue"
+        )
+
+
 @dataclass
 class _Pending:
     key: CacheKey
     entry: MPIEntry
     poses: np.ndarray
+    deadline: float | None = None  # monotonic; None = no deadline
     future: Future = field(default_factory=Future)
     enqueued_at: float = field(default_factory=time.monotonic)
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
 
 
 class MicroBatcher:
@@ -55,6 +93,8 @@ class MicroBatcher:
     max_batch_poses: pose-count ceiling per dispatch; a request is only
       absorbed if the whole group still fits. A single over-sized request
       still dispatches alone (the engine chunks internally).
+    max_queue_requests: pending-queue bound; submissions beyond it raise
+      QueueFull (0 = unbounded, the pre-admission-control behavior).
     """
 
     def __init__(
@@ -62,14 +102,20 @@ class MicroBatcher:
         render_fn: RenderFn,
         max_delay_ms: float = 4.0,
         max_batch_poses: int = 64,
+        max_queue_requests: int = 0,
         metrics: Any | None = None,
         tracer: Tracer | None = None,
     ):
         if max_batch_poses < 1:
             raise ValueError(f"max_batch_poses must be >= 1, got {max_batch_poses}")
+        if max_queue_requests < 0:
+            raise ValueError(
+                f"max_queue_requests must be >= 0, got {max_queue_requests}"
+            )
         self._render_fn = render_fn
         self.max_delay_s = max(0.0, max_delay_ms) / 1e3
         self.max_batch_poses = int(max_batch_poses)
+        self.max_queue_requests = int(max_queue_requests)
         self._metrics = metrics
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self._pending: deque[_Pending] = deque()
@@ -94,31 +140,60 @@ class MicroBatcher:
             self._cond.notify_all()
         if self._worker is not None:
             self._worker.join(timeout)
-        # fail any requests stranded by shutdown instead of hanging clients
+        # fail any requests stranded by shutdown instead of hanging clients;
+        # the TYPED exception lets the HTTP layer answer 503 (drain), not 500
         with self._cond:
             stranded = list(self._pending)
             self._pending.clear()
             self._gauge_locked()
         for p in stranded:
-            p.future.set_exception(RuntimeError("batcher stopped"))
+            p.future.set_exception(BatcherStopped())
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, key: CacheKey, entry: MPIEntry, poses: np.ndarray) -> Future:
-        """Enqueue one render request; resolves to (rgb, disp) host arrays."""
+    def submit(
+        self,
+        key: CacheKey,
+        entry: MPIEntry,
+        poses: np.ndarray,
+        deadline: float | None = None,
+    ) -> Future:
+        """Enqueue one render request; resolves to (rgb, disp) host arrays.
+
+        deadline: monotonic-clock instant after which the request must NOT
+        be dispatched — the worker fails it with DeadlineExceeded instead.
+        """
         poses = np.asarray(poses, np.float32)
         if poses.ndim != 3 or poses.shape[1:] != (4, 4):
             raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")
-        item = _Pending(key=key, entry=entry, poses=poses)
+        item = _Pending(key=key, entry=entry, poses=poses, deadline=deadline)
         with self._cond:
             if self._stop:
-                raise RuntimeError("batcher is stopped")
+                raise BatcherStopped()
+            if (self.max_queue_requests
+                    and len(self._pending) >= self.max_queue_requests):
+                shed = getattr(self._metrics, "shed_requests", None)
+                if shed is not None:
+                    shed.inc(reason="queue_full")
+                raise QueueFull(len(self._pending), self.max_queue_requests)
             self._pending.append(item)
             self._gauge_locked()
             self._cond.notify_all()
         if self._metrics is not None:
             self._metrics.batch_requests.inc()
         return item.future
+
+    def cancel(self, future: Future) -> bool:
+        """Evict a still-pending request (e.g. its client timed out and is
+        gone — rendering for it would be pure waste). True if evicted;
+        False when it already dispatched (the result is simply dropped)."""
+        with self._cond:
+            for item in self._pending:
+                if item.future is future:
+                    self._pending.remove(item)
+                    self._gauge_locked()
+                    return True
+        return False
 
     def queue_depth(self) -> int:
         with self._cond:
@@ -130,45 +205,72 @@ class MicroBatcher:
         if self._metrics is not None:
             self._metrics.batch_queue_depth.set(len(self._pending))
 
-    def _take_group(self) -> list[_Pending] | None:
-        """Block until work or stop; return one coalesced same-key group."""
-        with self._cond:
-            while not self._pending and not self._stop:
-                self._cond.wait()
-            if not self._pending:
-                return None  # stopping and drained
-            coalesce_t0 = time.perf_counter()
-            seed = self._pending.popleft()
-            group = [seed]
-            n_poses = seed.poses.shape[0]
-            deadline = seed.enqueued_at + self.max_delay_s
-            while True:
-                # sweep pending for the seed's key, preserving order of
-                # everything not absorbed; a candidate only joins if the
-                # whole group still fits the pose ceiling (an oversized
-                # SEED still dispatches alone — the engine chunks)
-                kept: deque[_Pending] = deque()
-                while self._pending:
-                    cand = self._pending.popleft()
-                    if (cand.key == seed.key
-                            and n_poses + cand.poses.shape[0]
-                            <= self.max_batch_poses):
-                        group.append(cand)
-                        n_poses += cand.poses.shape[0]
-                    else:
-                        kept.append(cand)
-                self._pending = kept
-                remaining = deadline - time.monotonic()
-                if (n_poses >= self.max_batch_poses or remaining <= 0
-                        or self._stop):
-                    break
-                self._cond.wait(timeout=remaining)
-            self._gauge_locked()
-            self._tracer.record(
-                "coalesce", "serve", coalesce_t0, time.perf_counter(),
-                requests=len(group), poses=n_poses,
+    def _fail_expired(self, items: list[_Pending]) -> None:
+        """Fail expired requests with the typed 504 exception + counter.
+        (Outside the condition lock: set_exception wakes blocked clients.)"""
+        now = time.monotonic()
+        for item in items:
+            timeouts = getattr(self._metrics, "request_timeouts", None)
+            if timeouts is not None:
+                timeouts.inc(stage="queue")
+            item.future.set_exception(
+                DeadlineExceeded(now - item.enqueued_at)
             )
-            return group
+
+    def _take_group(self) -> list[_Pending] | None:
+        """Block until work or stop; return one coalesced same-key group.
+        Expired requests encountered anywhere — as a would-be seed or
+        during the sweep — are failed, never dispatched."""
+        expired: list[_Pending] = []
+        try:
+            with self._cond:
+                while True:
+                    while not self._pending and not self._stop:
+                        self._cond.wait()
+                    if not self._pending:
+                        return None  # stopping and drained
+                    coalesce_t0 = time.perf_counter()
+                    seed = self._pending.popleft()
+                    if seed.expired(time.monotonic()):
+                        expired.append(seed)
+                        self._gauge_locked()
+                        continue
+                    break
+                group = [seed]
+                n_poses = seed.poses.shape[0]
+                deadline = seed.enqueued_at + self.max_delay_s
+                while True:
+                    # sweep pending for the seed's key, preserving order of
+                    # everything not absorbed; a candidate only joins if the
+                    # whole group still fits the pose ceiling (an oversized
+                    # SEED still dispatches alone — the engine chunks)
+                    kept: deque[_Pending] = deque()
+                    now = time.monotonic()
+                    while self._pending:
+                        cand = self._pending.popleft()
+                        if cand.expired(now):
+                            expired.append(cand)
+                        elif (cand.key == seed.key
+                                and n_poses + cand.poses.shape[0]
+                                <= self.max_batch_poses):
+                            group.append(cand)
+                            n_poses += cand.poses.shape[0]
+                        else:
+                            kept.append(cand)
+                    self._pending = kept
+                    remaining = deadline - time.monotonic()
+                    if (n_poses >= self.max_batch_poses or remaining <= 0
+                            or self._stop):
+                        break
+                    self._cond.wait(timeout=remaining)
+                self._gauge_locked()
+                self._tracer.record(
+                    "coalesce", "serve", coalesce_t0, time.perf_counter(),
+                    requests=len(group), poses=n_poses,
+                )
+                return group
+        finally:
+            self._fail_expired(expired)
 
     def _run(self) -> None:
         while True:
@@ -178,6 +280,15 @@ class MicroBatcher:
             self._dispatch(group)
 
     def _dispatch(self, group: list[_Pending]) -> None:
+        # last line of deadline defense: members can expire during the
+        # coalescing wait — drop them here rather than render into the void
+        now = time.monotonic()
+        expired = [p for p in group if p.expired(now)]
+        if expired:
+            self._fail_expired(expired)
+            group = [p for p in group if not p.expired(now)]
+            if not group:
+                return
         poses = np.concatenate([p.poses for p in group], axis=0)
         now = time.monotonic()
         if self._metrics is not None:

@@ -42,6 +42,7 @@ import numpy as np
 
 from mine_tpu.config import Config
 from mine_tpu.obs.cost import StepCost, compiled_cost, resolve_peak_flops
+from mine_tpu.resilience import chaos
 from mine_tpu.serving.cache import MPIEntry
 from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
 
@@ -280,6 +281,7 @@ class RenderEngine:
         """
         from mine_tpu.inference.video import prepare_image
 
+        chaos.maybe_raise("predict_raise")  # fault seam (resilience/chaos.py)
         bucket = self.bucket(spec)
         h, w, _ = bucket.spec
         img = prepare_image(image, h, w)
@@ -314,6 +316,7 @@ class RenderEngine:
         """
         import jax
 
+        chaos.maybe_raise("engine_raise")  # fault seam (resilience/chaos.py)
         poses = np.asarray(poses, np.float32)
         if poses.ndim != 3 or poses.shape[1:] != (4, 4):
             raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")

@@ -75,6 +75,33 @@ class ServingMetrics:
             "group dispatched (the latency cost of coalescing)",
         )
 
+        # admission control + fault tolerance (resilience PR)
+        self.shed_requests = r.counter(
+            "mine_serve_shed_requests_total",
+            "requests rejected before any work, by reason "
+            "(queue_full|breaker_open|draining)",
+        )
+        self.request_timeouts = r.counter(
+            "mine_serve_request_timeouts_total",
+            "requests that hit their deadline, by stage (queue = expired "
+            "before dispatch -> 504; result = client wait timed out and "
+            "the pending entry was evicted -> 504)",
+        )
+        self.breaker_state = r.gauge(
+            "mine_serve_breaker_state",
+            "circuit breaker state: 0 closed, 1 half-open, 2 open",
+        )
+        self.breaker_trips = r.counter(
+            "mine_serve_breaker_trips_total",
+            "closed/half-open -> open transitions after consecutive engine "
+            "failures",
+        )
+        self.engine_failures = r.counter(
+            "mine_serve_engine_failures_total",
+            "engine dispatch failures, by kind (predict/render) — the "
+            "breaker's input signal",
+        )
+
         # host-span tracing (obs/trace.py wired via ServingApp)
         self.trace_spans = r.counter(
             "mine_serve_trace_spans_total",

@@ -24,6 +24,18 @@ Endpoints:
                   Chrome-trace JSON: drop it into chrome://tracing, or
                   point tools/profile_summary.py at a saved copy.
 
+Admission control (resilience PR): the render queue is bounded — beyond
+`resilience.serve_max_queue_requests` pending requests the server sheds
+with 503 + Retry-After instead of accepting work it cannot finish; every
+render carries a deadline (body `timeout_s`, default
+`resilience.serve_deadline_s`, both clamped to request_timeout_s) that the
+batcher enforces BEFORE dispatch (504, and the client's wait timing out
+evicts the pending entry); and a circuit breaker around the engine trips
+after `resilience.breaker_failure_threshold` consecutive dispatch
+failures, shedding immediately (503) and reporting /healthz as degraded
+(HTTP 503) until a half-open trial succeeds. Overload is always an honest
+503/504 — never a hang, never a 500.
+
 CLI: python -m mine_tpu.serving.server --workspace <train workspace>
 restores params only (training/checkpoint.py load_for_serving), pre-warms
 the default bucket's executables, and serves until killed.
@@ -39,7 +51,7 @@ import json
 import os
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -47,10 +59,21 @@ import numpy as np
 
 from mine_tpu.config import Config
 from mine_tpu.obs.trace import Tracer
-from mine_tpu.serving.batcher import MicroBatcher
+from mine_tpu.resilience import BreakerOpen, CircuitBreaker
+from mine_tpu.serving.batcher import (
+    BatcherStopped,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueFull,
+)
 from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
 from mine_tpu.serving.engine import BucketSpec, RenderEngine
 from mine_tpu.serving.metrics import ServingMetrics
+
+
+class RequestTimeout(RuntimeError):
+    """The handler thread's wait on its future timed out; the pending
+    request (if still queued) was evicted. Maps to HTTP 504."""
 
 
 def _decode_image(data: bytes) -> np.ndarray:
@@ -110,8 +133,31 @@ class ServingApp:
         trace_enabled: bool = True,
         trace_buffer_spans: int = 4096,
         peak_flops_override: float = 0.0,
+        max_queue_requests: int | None = None,
+        deadline_s: float | None = None,
+        retry_after_s: float | None = None,
+        breaker_failure_threshold: int | None = None,
+        breaker_reset_s: float | None = None,
     ):
+        res = cfg.resilience  # ctor args override the resilience.* knobs
+
+        def knob(override, default):
+            return default if override is None else override
+
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # circuit breaker around the engine: consecutive dispatch failures
+        # open it; while open, requests shed immediately (503) instead of
+        # riding into a dead backend; half-opens on a timer for one trial
+        self.breaker = CircuitBreaker(
+            failure_threshold=knob(
+                breaker_failure_threshold, res.breaker_failure_threshold
+            ),
+            reset_after_s=knob(breaker_reset_s, res.breaker_reset_s),
+            on_state=self.metrics.breaker_state.set,
+            on_trip=self.metrics.breaker_trips.inc,
+        )
+        self.deadline_s = knob(deadline_s, res.serve_deadline_s)
+        self.retry_after_s = knob(retry_after_s, res.serve_retry_after_s)
         # request-lifecycle spans default ON (unlike training): a span is
         # nanoseconds against a millisecond render, and /debug/trace on a
         # misbehaving server is worth far more than the ring's few MB.
@@ -134,9 +180,12 @@ class ServingApp:
             self.allowed_buckets.add(tuple(int(v) for v in spec))
         self.cache = MPICache(cache_bytes, metrics=self.metrics)
         self.batcher = MicroBatcher(
-            self.engine.render, max_delay_ms=max_delay_ms,
-            max_batch_poses=max_batch_poses, metrics=self.metrics,
-            tracer=self.tracer,
+            self._guarded_render, max_delay_ms=max_delay_ms,
+            max_batch_poses=max_batch_poses,
+            max_queue_requests=knob(
+                max_queue_requests, res.serve_max_queue_requests
+            ),
+            metrics=self.metrics, tracer=self.tracer,
         ).start()
         self.request_timeout_s = request_timeout_s
         self._started_at = time.time()
@@ -146,6 +195,28 @@ class ServingApp:
         # run N encoder passes and materialize N ~100 MB MPIs)
         self._inflight: dict[Any, Future] = {}
         self._inflight_lock = threading.Lock()
+
+    # -- circuit breaker around the engine ------------------------------------
+
+    def _breaker_guard(self, kind: str, fn, *args):
+        """Run one engine dispatch under the breaker: open -> immediate
+        BreakerOpen (no device touch; half-open admits one trial); outcomes
+        feed the state machine. Client-side errors never reach here — the
+        callers validate first, so a failure IS an engine failure."""
+        if not self.breaker.allow():
+            self.metrics.shed_requests.inc(reason="breaker_open")
+            raise BreakerOpen(self.breaker.retry_after_s() or self.retry_after_s)
+        try:
+            result = fn(*args)
+        except BaseException:
+            self.metrics.engine_failures.inc(kind=kind)
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return result
+
+    def _guarded_render(self, entry, poses):
+        return self._breaker_guard("render", self.engine.render, entry, poses)
 
     def predict(self, image_bytes: bytes, spec: BucketSpec | None = None) -> dict:
         digest = hashlib.sha256(image_bytes).hexdigest()
@@ -186,11 +257,23 @@ class ServingApp:
                 self._inflight[key] = future
         if not owner:
             # follower: share the owner's encoder pass (its exception too)
-            return response(
-                future.result(timeout=self.request_timeout_s), cached=True
-            )
+            try:
+                return response(
+                    future.result(timeout=self.request_timeout_s), cached=True
+                )
+            except FutureTimeout:
+                self.metrics.request_timeouts.inc(stage="result")
+                raise RequestTimeout(
+                    f"predict singleflight wait exceeded "
+                    f"{self.request_timeout_s}s"
+                ) from None
         try:
-            entry = self.engine.predict(_decode_image(image_bytes), bucket.spec)
+            # decode OUTSIDE the breaker guard: undecodable bytes are the
+            # client's fault (400) and must not count as engine failures
+            image = _decode_image(image_bytes)
+            entry = self._breaker_guard(
+                "predict", self.engine.predict, image, bucket.spec
+            )
             self.cache.put(key, entry)
             future.set_result(entry)
         except BaseException as exc:
@@ -201,19 +284,55 @@ class ServingApp:
                 self._inflight.pop(key, None)
         return response(entry, cached=False)
 
-    def render(self, key_str: str, poses: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    def render(
+        self,
+        key_str: str,
+        poses: np.ndarray,
+        timeout_s: float | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
         key = key_from_str(key_str)
         entry = self.cache.get(key)
         if entry is None:
             raise KeyError(key_str)
-        future = self.batcher.submit(key, entry, poses)
-        return future.result(timeout=self.request_timeout_s)
+        if self.breaker.rejecting():
+            # pure admission probe — the half-open trial slot is consumed
+            # at dispatch time (_guarded_render), not here
+            self.metrics.shed_requests.inc(reason="breaker_open")
+            raise BreakerOpen(self.breaker.retry_after_s() or self.retry_after_s)
+        # per-request deadline, propagated INTO the batcher: if the queue
+        # outlives it the worker drops the request before dispatch (504)
+        timeout = min(
+            timeout_s if timeout_s and timeout_s > 0 else self.deadline_s,
+            self.request_timeout_s,
+        )
+        future = self.batcher.submit(
+            key, entry, poses, deadline=time.monotonic() + timeout
+        )
+        try:
+            return future.result(timeout=timeout)
+        except FutureTimeout:
+            # evict the pending entry so the worker never renders for a
+            # client that already gave up; if it is mid-dispatch the result
+            # is simply dropped
+            self.batcher.cancel(future)
+            self.metrics.request_timeouts.inc(stage="result")
+            raise RequestTimeout(
+                f"render did not complete within {timeout:.1f}s"
+            ) from None
 
     def health(self) -> dict:
         import jax
 
+        breaker_state = self.breaker.state
+        # "degraded" (503) only while OPEN. Half-open must report healthy:
+        # the breaker needs one real request to run its recovery trial, and
+        # a load balancer honoring a 503 here would starve it of exactly
+        # that traffic — the replica would stay drained forever.
+        status = {"closed": "ok", "half_open": "recovering"}.get(
+            breaker_state, "degraded"
+        )
         return {
-            "status": "ok",
+            "status": status,
             "uptime_s": round(time.time() - self._started_at, 1),
             "backend": jax.default_backend(),
             "checkpoint_step": self.engine.checkpoint_step,
@@ -222,6 +341,9 @@ class ServingApp:
             "cache_entries": len(self.cache),
             "cache_bytes_resident": self.cache.bytes_resident,
             "queue_depth": self.batcher.queue_depth(),
+            "queue_bound": self.batcher.max_queue_requests,
+            "breaker": breaker_state,
+            "breaker_trips": self.breaker.trips,
             "trace_enabled": self.tracer.enabled,
             "trace_spans_buffered": len(self.tracer),
         }
@@ -250,15 +372,24 @@ class _Handler(BaseHTTPRequestHandler):
         if self.server.verbose:
             super().log_message(fmt, *args)
 
-    def _send(self, code: int, payload: bytes, content_type: str) -> None:
+    def _send(
+        self, code: int, payload: bytes, content_type: str,
+        extra_headers: dict[str, str] | None = None,
+    ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(payload)
 
-    def _send_json(self, code: int, obj: dict) -> None:
-        self._send(code, json.dumps(obj).encode(), "application/json")
+    def _send_json(
+        self, code: int, obj: dict,
+        extra_headers: dict[str, str] | None = None,
+    ) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json",
+                   extra_headers)
 
     # One request body must not be able to exhaust host RAM: the largest
     # legitimate payload is a source image for /predict (a full-res PNG is
@@ -272,11 +403,44 @@ class _Handler(BaseHTTPRequestHandler):
             raise _BodyTooLarge(length)
         return self.rfile.read(length) if length else b""
 
+    def _overload_response(self, exc: Exception) -> int | None:
+        """Map the typed admission-control outcomes onto honest HTTP codes
+        (shed/drain -> 503 with Retry-After, deadline -> 504); None for
+        anything else (the caller's normal handling proceeds)."""
+        app = self.server.app
+        if isinstance(exc, BreakerOpen):
+            retry_after = max(exc.retry_after_s, 0.1)
+            self._send_json(
+                503, {"error": str(exc), "retry_after_s": retry_after},
+                {"Retry-After": f"{retry_after:.1f}"},
+            )
+            return 503
+        if isinstance(exc, QueueFull):
+            retry_after = max(app.retry_after_s, 0.1)
+            self._send_json(
+                503, {"error": str(exc), "retry_after_s": retry_after},
+                {"Retry-After": f"{retry_after:.1f}"},
+            )
+            return 503
+        if isinstance(exc, BatcherStopped):
+            app.metrics.shed_requests.inc(reason="draining")
+            self._send_json(503, {"error": f"{exc} (server draining)"})
+            return 503
+        if isinstance(exc, (DeadlineExceeded, RequestTimeout)):
+            self._send_json(504, {"error": str(exc)})
+            return 504
+        return None
+
     def _route(self, method: str, path: str) -> tuple[int, str]:
         app = self.server.app
         if method == "GET" and path == "/healthz":
-            self._send_json(200, app.health())
-            return 200, "healthz"
+            health = app.health()
+            # degraded (breaker OPEN) answers 503 so load balancers drain
+            # this replica; "recovering" (half-open) answers 200 so the
+            # recovery trial can arrive; the body carries the full snapshot
+            code = 503 if health["status"] == "degraded" else 200
+            self._send_json(code, health)
+            return code, "healthz"
         if method == "GET" and path == "/metrics":
             self._send(200, app.metrics.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
@@ -347,6 +511,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             with app.tracer.span("predict", cat="serve"):
                 result = app.predict(image_bytes, spec)
+        except (BreakerOpen, RequestTimeout) as exc:
+            return self._overload_response(exc)
         except (ValueError, OSError) as exc:
             # bad bucket (ValueError) or undecodable/truncated image bytes —
             # PIL's UnidentifiedImageError subclasses OSError, not ValueError
@@ -362,11 +528,18 @@ class _Handler(BaseHTTPRequestHandler):
                 key_str = req["mpi_key"]
                 key_from_str(key_str)  # malformed keys are a 400, not a 500
                 poses = _poses_from_body(req)
+                timeout_s = req.get("timeout_s")
+                if timeout_s is not None:
+                    timeout_s = float(timeout_s)
         except (KeyError, ValueError, TypeError) as exc:
             self._send_json(400, {"error": f"bad render body: {exc}"})
             return 400
         try:
-            rgb, disp = app.render(key_str, poses)
+            rgb, disp = app.render(key_str, poses, timeout_s=timeout_s)
+        except (BreakerOpen, QueueFull, BatcherStopped, DeadlineExceeded,
+                RequestTimeout) as exc:
+            # overload/drain/deadline: honest 503/504, never a hang or 500
+            return self._overload_response(exc)
         except KeyError:
             self._send_json(404, {
                 "error": f"mpi_key {key_str} not cached (evicted or never "

@@ -13,6 +13,7 @@ image_to_video.py:275-277).
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 from typing import Any
 
@@ -126,6 +127,60 @@ def load_paired_config(workspace: str, overrides: str | None = None) -> Config:
 
 def wait_until_finished(manager: ocp.CheckpointManager) -> None:
     manager.wait_until_finished()
+
+
+# -- last-good pointer (resilience/sentinel.py rollback target) ---------------
+
+
+def _last_good_path(workspace: str) -> str:
+    # plain-file IO -> sidecar mapping, like params.yaml/logs: a remote
+    # (gs://) workspace keeps its pointer on the training host
+    return os.path.join(local_sidecar_dir(workspace), "last_good.json")
+
+
+def mark_last_good(workspace: str, step: int) -> None:
+    """Atomically record `step` as the newest checkpoint known healthy
+    (saved while the training sentinel saw only finite losses). Distinct
+    from `latest_step()`: the newest checkpoint may postdate a trip."""
+    path = _last_good_path(workspace)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"step": int(step)}, fh)
+    os.replace(tmp, path)  # atomic on POSIX: readers see old or new, never half
+
+
+def last_good_step(workspace: str) -> int | None:
+    try:
+        with open(_last_good_path(workspace)) as fh:
+            return int(json.load(fh)["step"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def restore_last_good(
+    manager: ocp.CheckpointManager, state_template: Any, workspace: str,
+) -> tuple[Any, int]:
+    """Restore the newest RETAINED step <= the last-good pointer.
+
+    The pointer may name a step the manager's retention policy has since
+    deleted; the newest surviving step at-or-before it is the best
+    available rollback target. With no pointer (or nothing at/under it),
+    falls back to the newest retained step — under any sentinel policy the
+    in-graph mask guarantees even post-trip checkpoints never absorbed a
+    non-finite update, so newest-retained is safe, merely less vetted.
+    Raises FileNotFoundError when no checkpoint exists at all.
+    """
+    steps = sorted(int(s) for s in manager.all_steps())
+    if not steps:
+        raise FileNotFoundError(
+            f"rollback requested but {workspace} holds no checkpoint"
+        )
+    pointer = last_good_step(workspace)
+    candidates = [s for s in steps if pointer is None or s <= pointer]
+    step = max(candidates) if candidates else max(steps)
+    state = manager.restore(step, args=ocp.args.StandardRestore(state_template))
+    return state, step
 
 
 def load_for_serving(

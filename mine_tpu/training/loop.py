@@ -20,8 +20,10 @@ none of it costs anything.
 from __future__ import annotations
 
 import os
+import signal
 import time
-from typing import Any, Iterable
+from itertools import islice
+from typing import Any, Callable, Iterable
 
 import jax
 import numpy as np
@@ -47,6 +49,14 @@ from mine_tpu.parallel import (
     replicate_state,
     shard_batch,
 )
+from mine_tpu.resilience import (
+    PreemptedError,
+    PreemptionGuard,
+    SentinelAbort,
+    SentinelRollback,
+    TrainingSentinel,
+    chaos,
+)
 from mine_tpu.training import checkpoint as ckpt
 from mine_tpu.training.optimizer import learning_rates, make_optimizer
 from mine_tpu.training.step import build_model, init_state
@@ -66,13 +76,27 @@ LOSS_KEYS = (
 )
 
 
-def staged_batches(mesh, num_workers: int, epoch_iter: Iterable[dict]) -> Iterable[dict]:
+def staged_batches(
+    mesh,
+    num_workers: int,
+    epoch_iter: Iterable[dict],
+    retries: int = 0,
+    on_retry: Callable[[int, BaseException], None] | None = None,
+) -> Iterable[dict]:
     """Two-stage pipeline overlap (SURVEY.md §7.4.7; the reference builds
     every batch synchronously in the step loop, nerf_dataset.py:199-236):
     host batches are produced up to `num_workers` ahead, but at most 2 of
     them are device-staged (shard_batch) at a time — double-buffered H2D
-    without pinning num_workers full batches in HBM."""
-    host = prefetch(epoch_iter, max(num_workers - 2, 0))
+    without pinning num_workers full batches in HBM.
+
+    `retries` (data.loader_retries) bounds transient-error retries of the
+    host stage (exponential backoff + jitter, data/pipeline.py), which also
+    hosts the `loader_raise` chaos seam; the device-staging stage never
+    retries (a failed device transfer is not a loader hiccup)."""
+    host = prefetch(
+        epoch_iter, max(num_workers - 2, 0),
+        retries=retries, on_retry=on_retry, fault_seam="loader_raise",
+    )
     return prefetch(
         host, min(num_workers, 2), transfer=lambda b: shard_batch(mesh, b)
     )
@@ -106,6 +130,15 @@ class TrainObsMetrics:
         self.imgs_per_sec = r.gauge(
             "mine_train_imgs_per_sec", "global training throughput",
         )
+        self.grad_norm = r.gauge(
+            "mine_train_grad_norm",
+            "global gradient norm at the latest logged step",
+        )
+        self.data_retries = r.counter(
+            "mine_train_data_retries_total",
+            "host batches retried after transient loader/staging errors "
+            "(data.loader_retries)",
+        )
 
 
 class Trainer:
@@ -136,6 +169,7 @@ class Trainer:
                 last_k_spans=cfg.obs.flight_last_k_spans,
                 get_status=self._flight_status,
             )
+        self._manager: Any = None  # live CheckpointManager during fit()
         self._train_cost = None  # StepCost of the AOT-compiled step
         self._compiled_train_step = None
         self._peak_flops = None
@@ -143,6 +177,10 @@ class Trainer:
         self.mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
         self.logger = make_logger(self.local_dir)
         self.writer = MetricWriter(self.local_dir)
+        self.sentinel = TrainingSentinel(
+            cfg.resilience, self.obs_metrics.registry, self.logger,
+            flight=self.flight,
+        )
         self.model = build_model(cfg, **model_axes(self.mesh))
         self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
         if jax.process_index() == 0:
@@ -156,7 +194,18 @@ class Trainer:
                 )
 
     def _staged_batches(self, epoch_iter: Iterable[dict]) -> Iterable[dict]:
-        return staged_batches(self.mesh, self.cfg.data.num_workers, epoch_iter)
+        return staged_batches(
+            self.mesh, self.cfg.data.num_workers, epoch_iter,
+            retries=self.cfg.data.loader_retries,
+            on_retry=self._on_loader_retry,
+        )
+
+    def _on_loader_retry(self, attempt: int, exc: BaseException) -> None:
+        self.obs_metrics.data_retries.inc()
+        self.logger.warning(
+            "transient loader error (retry %d): %s: %s",
+            attempt, type(exc).__name__, exc,
+        )
 
     def fit(self, train_ds: Any, val_ds: Any | None = None) -> dict[str, float]:
         cfg = self.cfg
@@ -234,6 +283,13 @@ class Trainer:
 
         if self.flight is not None:
             self.flight.start()
+        # preemption guard AFTER the flight recorder, so its SIGTERM handler
+        # chains: atomic save -> flight dump -> re-delivered termination
+        guard: PreemptionGuard | None = None
+        if cfg.resilience.preempt_save:
+            guard = PreemptionGuard(self._preempt_save, logger=self.logger)
+            guard.install()
+        self._manager = manager
         self._live_state = state  # emergency-save target from the first step on
         try:
             last_val = self._fit_epochs(
@@ -261,11 +317,50 @@ class Trainer:
                 self.logger.exception("emergency checkpoint failed")
             raise
         finally:
+            if guard is not None:
+                guard.uninstall()
             self._live_state = None  # don't pin the state in HBM after fit
+            self._manager = None
             if self.flight is not None:
                 self.flight.stop()
             self._export_host_trace()
+            try:
+                # every exit path — normal, emergency, preempted — drains
+                # pending async checkpoint writes before the process can die
+                ckpt.wait_until_finished(manager)
+            except Exception:  # noqa: BLE001 - never mask the original error
+                self.logger.exception("checkpoint drain failed")
         return last_val
+
+    def _preempt_save(self, reason: str) -> None:
+        """Out-of-band atomic checkpoint (resilience/preempt.py): runs in
+        the SIGTERM/SIGUSR2 handler on the main thread, i.e. between
+        bytecodes of the step loop — `_live_state` is always the last
+        COMPLETED step. Skips steps already on disk, waits for the write,
+        and advances the last-good pointer."""
+        state, manager = self._live_state, self._manager
+        if state is None or manager is None:
+            return  # not inside fit()
+        host_state = jax.device_get(state)
+        step = int(host_state.step)
+        self.logger.warning(
+            "preemption save (%s): persisting step %d", reason, step
+        )
+        ckpt.wait_until_finished(manager)  # don't race a periodic async save
+        if step not in {int(s) for s in manager.all_steps()}:
+            ckpt.save(manager, host_state, step)
+            ckpt.wait_until_finished(manager)
+        # the pointer stays sentinel-vetted even out-of-band: vet() never
+        # raises (we are in a signal handler) — a bad verdict leaves the
+        # old pointer in place and defers the policy trip to the next
+        # check() (matters for SIGUSR2 save-and-continue)
+        if self.sentinel.vet(step):
+            ckpt.mark_last_good(self.workspace, step)
+        else:
+            self.logger.warning(
+                "preemption save: step %d saved but NOT marked last-good "
+                "(unvetted non-finite flags)", step,
+            )
 
     def _flight_status(self) -> dict:
         """What a flight dump's meta.json records about this trainer: the
@@ -359,9 +454,61 @@ class Trainer:
         self, cfg, train_ds, val_ds, state, train_step, eval_step,
         manager, meters, start_step,
     ) -> dict[str, float]:
+        """Rollback driver around the epoch runner: a SentinelRollback
+        restores the last-good checkpoint, rebuilds the data iterator at
+        that position (the runner's mid-epoch start), and retries — at most
+        resilience.max_rollbacks times before escalating to abort."""
+        global_step = start_step
+        rollbacks = 0
+        while True:
+            try:
+                return self._run_epochs(
+                    cfg, train_ds, val_ds, state, train_step, eval_step,
+                    manager, meters, global_step,
+                )
+            except SentinelRollback as trip:
+                rollbacks += 1
+                self.sentinel.rollbacks.inc()
+                if rollbacks > cfg.resilience.max_rollbacks:
+                    raise SentinelAbort(
+                        f"{rollbacks} sentinel rollbacks exceed "
+                        f"resilience.max_rollbacks="
+                        f"{cfg.resilience.max_rollbacks}: {trip}"
+                    ) from trip
+                ckpt.wait_until_finished(manager)
+                live = self._live_state if self._live_state is not None else state
+                template = jax.device_get(live)
+                try:
+                    host_state, restored = ckpt.restore_last_good(
+                        manager, template, self.workspace
+                    )
+                except FileNotFoundError as exc:
+                    raise SentinelAbort(
+                        f"rollback impossible ({exc}); original trip: {trip}"
+                    ) from trip
+                self.logger.warning(
+                    "sentinel rollback #%d (%s): restored last-good step %d; "
+                    "re-seeding the data iterator there", rollbacks, trip,
+                    restored,
+                )
+                state = replicate_state(host_state, self.mesh)
+                self._live_state = state
+                global_step = restored
+                self.sentinel.reset_after_rollback()
+
+    def _run_epochs(
+        self, cfg, train_ds, val_ds, state, train_step, eval_step,
+        manager, meters, start_step,
+    ) -> dict[str, float]:
         steps_per_epoch = len(train_ds)
         global_step = start_step
         start_epoch = start_step // steps_per_epoch + 1
+        # data-iterator position restore: loaders are deterministic in
+        # (epoch, step), so a mid-epoch start is "skip the first k host
+        # batches of epoch start_epoch" — the resumed run then sees exactly
+        # the stream the uninterrupted run would have (bitwise resume)
+        skip_into_epoch = start_step % steps_per_epoch
+        chaos_sched = chaos.active()
         last_val: dict[str, float] = {}
         tracer = self.tracer
         cost_pending = cfg.obs.enabled and cfg.obs.cost_enabled
@@ -373,8 +520,18 @@ class Trainer:
             for m in meters.values():
                 m.reset()
             self._progress.update(epoch=epoch, global_step=global_step)
-            batches = iter(self._staged_batches(train_ds.epoch(epoch)))
+            epoch_iter = train_ds.epoch(epoch)
             step_in_epoch = 0
+            if epoch == start_epoch and skip_into_epoch:
+                # islice consumes the skipped batches lazily on the host
+                # side, before the prefetch stages ever stage them on device
+                epoch_iter = islice(epoch_iter, skip_into_epoch, None)
+                step_in_epoch = skip_into_epoch
+                self.logger.info(
+                    "mid-epoch resume: skipping %d already-trained batches "
+                    "of epoch %d", skip_into_epoch, epoch,
+                )
+            batches = iter(self._staged_batches(epoch_iter))
             while True:
                 with tracer.span("data", cat="train"):
                     batch = next(batches, None)
@@ -388,14 +545,36 @@ class Trainer:
                     )
                 if self.profile_steps and global_step == profile_at:
                     jax.profiler.start_trace(os.path.join(self.local_dir, "profile"))
+                if (chaos_sched is not None
+                        and chaos_sched.should("nan_loss", at=global_step + 1)):
+                    # poison through the REAL graph: NaN pixels make the
+                    # loss/grads non-finite exactly as a corrupt shard would
+                    self.logger.warning(
+                        "chaos: poisoning step %d's batch with NaNs",
+                        global_step + 1,
+                    )
+                    batch = dict(batch)
+                    batch["src_img"] = batch["src_img"] * float("nan")
                 with tracer.span("step", cat="train", step=global_step + 1):
                     state, loss_dict = train_step(state, batch)
                 self._live_state = state  # for the emergency checkpoint
                 global_step += 1
                 steps_since_log += 1
                 self._progress["global_step"] = global_step
+                self.sentinel.observe(
+                    global_step, loss_dict.get("update_skipped")
+                )
                 if self.flight is not None:
                     self.flight.heartbeat(step=global_step)
+                if chaos_sched is not None:
+                    if chaos_sched.should("preempt_exit", at=global_step):
+                        raise PreemptedError(
+                            f"chaos preempt_exit after step {global_step}"
+                        )
+                    if chaos_sched.should("sigusr2", at=global_step):
+                        os.kill(os.getpid(), signal.SIGUSR2)
+                    if chaos_sched.should("sigterm", at=global_step):
+                        os.kill(os.getpid(), signal.SIGTERM)
                 if (self.profile_steps
                         and global_step == profile_at + self.profile_steps):
                     jax.block_until_ready(loss_dict["loss"])
@@ -407,11 +586,13 @@ class Trainer:
                     # one transfer for the whole dict: per-key float() would
                     # block on a device sync PER KEY per log step
                     with tracer.span("sync", cat="train", step=global_step):
+                        fetch = {k: loss_dict[k] for k in LOSS_KEYS}
+                        if "grad_norm" in loss_dict:
+                            fetch["grad_norm"] = loss_dict["grad_norm"]
+                        host_vals = jax.device_get(fetch)
+                        grad_norm = host_vals.pop("grad_norm", None)
                         host_losses = {
-                            k: float(v)
-                            for k, v in jax.device_get(
-                                {k: loss_dict[k] for k in LOSS_KEYS}
-                            ).items()
+                            k: float(v) for k, v in host_vals.items()
                         }
                     with tracer.span("log", cat="train", step=global_step):
                         for k, v in host_losses.items():
@@ -436,15 +617,29 @@ class Trainer:
                         self.writer.scalars(host_losses, global_step, prefix="train/")
                         self.writer.scalar("train/imgs_per_sec", rate, global_step)
                         self.writer.scalar("train/backbone_lr", lrs["backbone_lr"], global_step)
+                        if grad_norm is not None:
+                            self.obs_metrics.grad_norm.set(float(grad_norm))
+                            self.writer.scalar(
+                                "train/grad_norm", float(grad_norm), global_step
+                            )
                         self._publish_mfu(interval_s / n_steps, global_step)
                     if tracer.enabled:
                         # AFTER the log span closes, so this interval's own
                         # sync/log phases are in the summary it publishes
                         self._publish_phases(global_step)
+                    # the scalars are logged/written first, THEN the
+                    # sentinel judges them: a trip leaves its evidence in
+                    # the log stream it is about to interrupt
+                    self.sentinel.check(host_losses["loss"], global_step)
 
                 if global_step % cfg.training.checkpoint_interval == 0:
+                    # resolve pending finiteness flags BEFORE the save: a
+                    # trip here rolls back/aborts instead of blessing a
+                    # suspect step as the new last-good
+                    self.sentinel.flush(global_step)
                     with tracer.span("ckpt", cat="train", step=global_step):
                         ckpt.save(manager, jax.device_get(state), global_step)
+                    ckpt.mark_last_good(self.workspace, global_step)
                     self.logger.info("checkpoint saved @ step %d", global_step)
 
                 if val_ds is not None and (
@@ -466,9 +661,14 @@ class Trainer:
                 )
                 self.writer.scalars(epoch_avg, global_step, prefix="train_epoch/")
 
+        self.sentinel.flush(global_step)
         with tracer.span("ckpt", cat="train", step=global_step):
-            ckpt.save(manager, jax.device_get(state), global_step)
+            # an exact-resume restart (or a preemption save that landed on
+            # the final step) may already hold this step on disk
+            if global_step not in {int(s) for s in manager.all_steps()}:
+                ckpt.save(manager, jax.device_get(state), global_step)
             ckpt.wait_until_finished(manager)
+            ckpt.mark_last_good(self.workspace, global_step)
         self.writer.flush()
         return last_val
 

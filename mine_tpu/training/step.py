@@ -552,9 +552,19 @@ def make_train_step(
     automatic psum of the replicated-param cotangent across the mesh sums
     them into the exact full-S gradient (a plane pmean would shrink it by
     the plane count).
+
+    Sentinel instrumentation (resilience/sentinel.py): the returned
+    loss_dict always carries `grad_norm` (the post-reduction global
+    gradient norm) and `update_skipped`. With any
+    `resilience.sentinel_policy` other than "off", the step additionally
+    masks the whole update in-graph when `isfinite(loss) & isfinite(|g|)`
+    is false — params, optimizer state, and BN stats keep their previous
+    values (`update_skipped` reports 1.0), while step/RNG still advance so
+    the data and key streams move past the poisoned batch.
     """
     if compositor is None:
         compositor = ops.compositor_from_config(cfg)
+    sentinel_mask = cfg.resilience.sentinel_policy != "off"
 
     def train_step(state: TrainState, batch: dict[str, Array]):
         rng = jax.random.fold_in(state.rng, state.step)
@@ -597,6 +607,21 @@ def make_train_step(
             loss_dict = lax.pmean(loss_dict, axis_name)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        # post-reduction, so every replica computes the identical norm and
+        # the identical finite verdict (a NaN anywhere pmean-poisons all)
+        grad_norm = optax.global_norm(grads)
+        loss_dict["grad_norm"] = grad_norm
+        finite = jnp.isfinite(loss_dict["loss"]) & jnp.isfinite(grad_norm)
+        if sentinel_mask:
+            keep = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda n, o: jnp.where(finite, n, o), new, old
+            )
+            new_params = keep(new_params, state.params)
+            new_opt_state = keep(new_opt_state, state.opt_state)
+            new_stats = keep(new_stats, state.batch_stats)
+            loss_dict["update_skipped"] = 1.0 - finite.astype(jnp.float32)
+        else:
+            loss_dict["update_skipped"] = jnp.zeros((), jnp.float32)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

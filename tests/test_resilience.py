@@ -1,0 +1,865 @@
+"""Fault-tolerance layer tests (mine_tpu/resilience/ + its wiring).
+
+Everything here is CPU-provable through the chaos harness
+(resilience/chaos.py): the fault schedule is deterministic, each fault
+fires once, and the assertions read the same counters/gauges production
+monitoring would. The expensive pieces (one jitted train step, one short
+Trainer.fit) are shared or kept to the smallest config the architecture
+admits (128x128, 18 layers, 2 planes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mine_tpu.resilience import chaos
+from mine_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from mine_tpu.serving.batcher import (
+    BatcherStopped,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueFull,
+)
+from mine_tpu.serving.cache import MPIEntry, key_to_str, mpi_key
+from mine_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    """Every test starts and ends without an installed fault schedule."""
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# ------------------------------------------------------------- chaos grammar
+
+
+def test_chaos_spec_grammar_and_fire_once():
+    s = chaos.ChaosSchedule(
+        "nan_loss@step=7,loader_raise@batch=3,engine_raise@render=2"
+    )
+    # value-keyed: fires at the matching step, exactly once
+    assert not s.should("nan_loss", at=6)
+    assert s.should("nan_loss", at=7)
+    assert not s.should("nan_loss", at=7)  # a replay does not re-fire
+    # invocation-keyed: internal per-kind count
+    assert not s.should("loader_raise")
+    assert not s.should("loader_raise")
+    assert s.should("loader_raise")
+    assert not s.should("loader_raise")
+    assert s.pending() == ["engine_raise@render=2"]
+
+    with pytest.raises(ValueError, match="unknown"):
+        chaos.ChaosSchedule("frobnicate@step=1")
+    with pytest.raises(ValueError, match="counts"):
+        chaos.ChaosSchedule("nan_loss@batch=1")  # wrong counter name
+    with pytest.raises(ValueError, match="kind@counter"):
+        chaos.ChaosSchedule("nan_loss=3")
+    with pytest.raises(ValueError, match=">= 1"):
+        chaos.ChaosSchedule("nan_loss@step=0")
+    # value-keyed kinds demand the caller's counter
+    with pytest.raises(ValueError, match="needs at="):
+        s.should("sigterm")
+
+
+def test_chaos_env_activation(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "sigusr2@step=5")
+    chaos.uninstall()  # force a re-read of the (patched) environment
+    assert chaos.should("sigusr2", at=5)
+    assert not chaos.should("sigusr2", at=5)
+    chaos.uninstall()
+    monkeypatch.delenv(chaos.ENV_VAR)
+    chaos.uninstall()
+    assert chaos.active() is None
+    assert not chaos.should("sigusr2", at=5)  # disabled = cheap False
+
+    chaos.install("engine_raise@render=1")
+    with pytest.raises(chaos.ChaosFault):
+        chaos.maybe_raise("engine_raise")
+    chaos.maybe_raise("engine_raise")  # second call: fault already spent
+
+
+# ------------------------------------------------------------ circuit breaker
+
+
+def test_circuit_breaker_state_machine():
+    clock = [0.0]
+    states: list[int] = []
+    b = CircuitBreaker(
+        failure_threshold=2, reset_after_s=10.0, clock=lambda: clock[0],
+        on_state=states.append,
+    )
+    assert b.state == "closed" and b.allow() and not b.rejecting()
+    b.record_failure()
+    assert b.state == "closed"  # 1 of 2
+    b.record_failure()
+    assert b.state == "open" and b.trips == 1
+    assert not b.allow() and b.rejecting()
+    assert b.retry_after_s() == pytest.approx(10.0)
+    # a success elsewhere cannot happen while open (allow() is False), but
+    # an intervening success resets the consecutive count when closed
+    clock[0] = 9.9
+    assert not b.allow()
+    clock[0] = 10.1
+    assert not b.rejecting()  # half-open admits traffic to the trial
+    assert b.state == "half_open"
+    assert b.allow()       # the single trial slot
+    assert not b.allow()   # a second concurrent trial is rejected
+    b.record_failure()     # trial failed -> re-open, timer restarts
+    # re-opening from half-open IS a new open transition; trips counts them
+    assert b.state == "open" and b.trips == 2
+    clock[0] = 20.2
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow() and b.allow()
+    assert states[0] == 0 and 2 in states and states[-1] == 0
+
+    off = CircuitBreaker(failure_threshold=0)
+    for _ in range(10):
+        off.record_failure()
+    assert off.allow() and off.state == "closed"
+
+
+# ---------------------------------------------------------- batcher admission
+
+
+def _entry(h=8, w=8, s=2) -> MPIEntry:
+    return MPIEntry(
+        mpi_rgb=np.zeros((1, s, h, w, 3), np.float32),
+        mpi_sigma=np.zeros((1, s, h, w, 1), np.float32),
+        disparity=np.zeros((1, s), np.float32),
+        k=np.zeros((1, 3, 3), np.float32),
+        bucket=(h, w, s),
+    )
+
+
+def _poses(n=1):
+    return np.tile(np.eye(4, dtype=np.float32)[None], (n, 1, 1))
+
+
+def _ok_render(entry, poses):
+    n = poses.shape[0]
+    return (np.zeros((n, 8, 8, 3), np.float32),
+            np.ones((n, 8, 8, 1), np.float32))
+
+
+def test_batcher_queue_bound_sheds_with_typed_error():
+    m = ServingMetrics()
+    batcher = MicroBatcher(_ok_render, max_queue_requests=2, metrics=m)
+    key = mpi_key("a", 0, (8, 8, 2))
+    futs = [batcher.submit(key, _entry(), _poses()) for _ in range(2)]
+    with pytest.raises(QueueFull, match="queue full"):
+        batcher.submit(key, _entry(), _poses())
+    assert m.shed_requests.value(reason="queue_full") == 1
+    # worker drains the admitted two; the bound is on PENDING work
+    batcher.start()
+    for f in futs:
+        f.result(timeout=30)
+    batcher.stop()
+
+
+def test_batcher_deadline_drops_before_dispatch():
+    m = ServingMetrics()
+    dispatched = []
+
+    def render(entry, poses):
+        dispatched.append(poses.shape[0])
+        return _ok_render(entry, poses)
+
+    batcher = MicroBatcher(render, max_delay_ms=0.0, metrics=m)
+    key = mpi_key("a", 0, (8, 8, 2))
+    # already expired when the worker first sees it
+    dead = batcher.submit(key, _entry(), _poses(),
+                          deadline=time.monotonic() - 0.01)
+    live = batcher.submit(key, _entry(), _poses())
+    batcher.start()
+    try:
+        assert live.result(timeout=30)[0].shape == (1, 8, 8, 3)
+        with pytest.raises(DeadlineExceeded):
+            dead.result(timeout=30)
+    finally:
+        batcher.stop()
+    assert dispatched == [1]  # the expired request never reached the engine
+    assert m.request_timeouts.value(stage="queue") == 1
+
+
+def test_batcher_cancel_evicts_pending_and_stop_is_typed():
+    batcher = MicroBatcher(_ok_render)  # never started: all stay pending
+    key = mpi_key("a", 0, (8, 8, 2))
+    f1 = batcher.submit(key, _entry(), _poses())
+    f2 = batcher.submit(key, _entry(), _poses())
+    assert batcher.cancel(f1) is True
+    assert batcher.cancel(f1) is False  # already gone
+    assert batcher.queue_depth() == 1
+    batcher.stop()
+    with pytest.raises(BatcherStopped):
+        f2.result(timeout=1)  # stranded by shutdown -> typed drain error
+    with pytest.raises(BatcherStopped):
+        batcher.submit(key, _entry(), _poses())
+
+
+# --------------------------------------------------------------- data retry
+
+
+def test_prefetch_retries_transient_then_succeeds():
+    from mine_tpu.data.pipeline import TransientLoaderError, prefetch
+
+    failures = {"left": 2}
+    retried: list[int] = []
+
+    def flaky_transfer(item):
+        if failures["left"] > 0:
+            failures["left"] -= 1
+            raise TransientLoaderError("storage hiccup")
+        return item * 10
+
+    out = list(prefetch(
+        iter([1, 2, 3]), depth=2, transfer=flaky_transfer, retries=3,
+        retry_base_delay_s=0.001,
+        on_retry=lambda attempt, exc: retried.append(attempt),
+    ))
+    assert out == [10, 20, 30]
+    assert retried == [1, 2]  # two backoff retries, then clean
+
+    # exhaustion re-raises the transient error at the consumer
+    always = prefetch(
+        iter([1]), depth=1,
+        transfer=lambda item: (_ for _ in ()).throw(
+            TransientLoaderError("dead disk")
+        ),
+        retries=2, retry_base_delay_s=0.001,
+    )
+    with pytest.raises(TransientLoaderError, match="dead disk"):
+        list(always)
+
+    # non-transient errors fail fast: no retry, first raise relays
+    calls = {"n": 0}
+
+    def buggy(item):
+        calls["n"] += 1
+        raise KeyError("shape bug")
+
+    with pytest.raises(KeyError):
+        list(prefetch(iter([1]), depth=0, transfer=buggy, retries=5))
+    assert calls["n"] == 1
+
+
+def test_prefetch_chaos_loader_seam_counts_batches():
+    from mine_tpu.data.pipeline import prefetch
+
+    chaos.install("loader_raise@batch=2")
+    retried: list[str] = []
+    out = list(prefetch(
+        iter("abcd"), depth=0, retries=1, retry_base_delay_s=0.001,
+        on_retry=lambda attempt, exc: retried.append(type(exc).__name__),
+        fault_seam="loader_raise",
+    ))
+    # batch 2 raised once (transient), was retried, and the stream is whole
+    assert out == list("abcd")
+    assert retried == ["ChaosFault"]
+
+    # with retries=0 the injected fault relays to the consumer
+    chaos.install("loader_raise@batch=1")
+    with pytest.raises(chaos.ChaosFault):
+        list(prefetch(iter("ab"), depth=1, fault_seam="loader_raise"))
+
+
+def test_prefetch_pull_retry_is_opt_in():
+    """Source-iterator pull retry: retried for loaders declaring
+    retry_safe_iter (per-batch __next__ work), NEVER for generators (a
+    closed generator would silently truncate the epoch)."""
+    from mine_tpu.data.pipeline import TransientLoaderError, prefetch
+
+    class FlakyLoader:
+        retry_safe_iter = True
+
+        def __init__(self):
+            self.i = 0
+            self.failed = False
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            if self.i == 2 and not self.failed:
+                self.failed = True
+                raise TransientLoaderError("NFS hiccup reading batch 2")
+            if self.i >= 4:
+                raise StopIteration
+            self.i += 1
+            return self.i
+
+    retried: list[int] = []
+    out = list(prefetch(FlakyLoader(), depth=2, retries=2,
+                        retry_base_delay_s=0.001,
+                        on_retry=lambda a, e: retried.append(a)))
+    assert out == [1, 2, 3, 4]  # the whole epoch, one retried pull
+    assert retried == [1]
+
+    # a generator raising the same error relays on the FIRST failure even
+    # with retries configured (no opt-in flag -> no pull retry)
+    def gen():
+        yield 1
+        raise TransientLoaderError("dead generator")
+
+    with pytest.raises(TransientLoaderError, match="dead generator"):
+        list(prefetch(gen(), depth=0, retries=5, retry_base_delay_s=0.001))
+
+
+# ------------------------------------------------- last-good pointer + resume
+
+
+def test_last_good_pointer_and_restore(tmp_path):
+    from mine_tpu.training import checkpoint as ckpt
+
+    ws = str(tmp_path / "ws")
+    manager = ckpt.checkpoint_manager(ws, max_to_keep=10)
+    template = {"w": np.zeros(3, np.float32)}
+    for step in (2, 4, 6):
+        ckpt.save(manager, {"w": np.full(3, float(step), np.float32)}, step)
+    ckpt.wait_until_finished(manager)
+
+    assert ckpt.last_good_step(ws) is None
+    ckpt.mark_last_good(ws, 4)
+    assert ckpt.last_good_step(ws) == 4
+    state, step = ckpt.restore_last_good(manager, template, ws)
+    assert step == 4 and float(state["w"][0]) == 4.0
+
+    # pointer names a GC'd step -> newest retained at-or-before it
+    ckpt.mark_last_good(ws, 5)
+    _, step = ckpt.restore_last_good(manager, template, ws)
+    assert step == 4
+    # pointer older than every retained step -> newest retained (fallback)
+    ckpt.mark_last_good(ws, 1)
+    _, step = ckpt.restore_last_good(manager, template, ws)
+    assert step == 6
+    # no pointer at all -> newest
+    os.remove(os.path.join(ckpt.local_sidecar_dir(ws), "last_good.json"))
+    _, step = ckpt.restore_last_good(manager, template, ws)
+    assert step == 6
+
+    empty = ckpt.checkpoint_manager(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        ckpt.restore_last_good(empty, template, str(tmp_path / "empty"))
+
+
+# ----------------------------------------------------------- preemption guard
+
+
+def test_preemption_guard_saves_then_chains():
+    from mine_tpu.resilience.preempt import PreemptionGuard
+
+    events: list[str] = []
+
+    def benign_prev(signum, frame):
+        events.append("prev_handler")
+
+    prev_term = signal.signal(signal.SIGTERM, benign_prev)
+    prev_usr2 = signal.getsignal(signal.SIGUSR2)
+    try:
+        guard = PreemptionGuard(
+            lambda reason: events.append(f"save:{reason}")
+        ).install()
+        try:
+            # SIGTERM: save FIRST, then the chained (flight-recorder-style)
+            # handler — and the test process survives because the chained
+            # handler is benign here
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert events == ["save:signal_sigterm", "prev_handler"]
+            # SIGUSR2 with default disposition: save-and-continue (the
+            # default action — terminate — must NOT be chained)
+            os.kill(os.getpid(), signal.SIGUSR2)
+            assert events[-1] == "save:signal_sigusr2"
+            assert guard.triggered == ["SIGTERM", "SIGUSR2"]
+        finally:
+            guard.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is benign_prev
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGUSR2, prev_usr2)
+
+
+def test_preemption_guard_save_failure_never_blocks_chain():
+    from mine_tpu.resilience.preempt import PreemptionGuard
+
+    events: list[str] = []
+    prev = signal.signal(signal.SIGUSR2, lambda s, f: events.append("prev"))
+    try:
+        def broken_save(reason):
+            raise RuntimeError("disk full")
+
+        guard = PreemptionGuard(broken_save,
+                                signals=(signal.SIGUSR2,)).install()
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            assert events == ["prev"]  # the chain still ran
+        finally:
+            guard.uninstall()
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+
+
+# ----------------------------------------------- server admission over HTTP
+
+
+def _http(base: str, path: str, data=None, headers=None, timeout=60):
+    req = urllib.request.Request(base + path, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), err.read()
+
+
+@pytest.fixture()
+def admission_app():
+    """A real ServingApp/HTTP server over FAKE weights with a fake cached
+    MPI: the render path (batcher, breaker, deadlines) is exercised with a
+    monkeypatchable `app.engine.render` and zero XLA compiles."""
+    from mine_tpu.config import Config
+    from mine_tpu.serving.server import ServingApp, make_server
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "mpi.num_bins_coarse": 2,
+    })
+    app = ServingApp(
+        cfg, params={"w": np.zeros(1, np.float32)}, batch_stats={},
+        max_delay_ms=0.0, request_timeout_s=60.0,
+        max_queue_requests=2, deadline_s=30.0, retry_after_s=0.5,
+        breaker_failure_threshold=2, breaker_reset_s=0.4,
+    )
+    key = mpi_key("fake", 0, (8, 8, 2))
+    app.cache.put(key, _entry())
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        yield app, f"http://{host}:{port}", key_to_str(key)
+    finally:
+        server.shutdown()
+        app.close()
+
+
+def _render_req(base, key_str, timeout_s=None, offsets=((0.01, 0.0, 0.0),)):
+    payload = {"mpi_key": key_str, "offsets": [list(o) for o in offsets]}
+    if timeout_s is not None:
+        payload["timeout_s"] = timeout_s
+    return _http(base, "/render", data=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json"})
+
+
+def test_server_sheds_overload_with_503_and_retry_after(admission_app):
+    app, base, key_str = admission_app
+
+    real_render = app.engine.render
+    app.engine.render = lambda entry, poses: (
+        time.sleep(0.35), _ok_render(entry, poses)
+    )[1]
+    try:
+        codes: list[int] = []
+        headers: list[dict] = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(8)
+
+        def one():
+            barrier.wait()
+            c, h, _ = _render_req(base, key_str, timeout_s=5.0)
+            with lock:
+                codes.append(c)
+                headers.append(h)
+
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(codes) == 8  # every request got an answer — no hangs
+        # overload is 503 (shed) or 200 (made it through a 2-deep queue +
+        # one in flight) — NEVER a 500
+        assert set(codes) <= {200, 503}, codes
+        assert codes.count(503) >= 1 and codes.count(200) >= 1
+        shed = [h for c, h in zip(codes, headers) if c == 503]
+        assert all("Retry-After" in h for h in shed)
+        assert app.metrics.shed_requests.value(reason="queue_full") >= 1
+    finally:
+        app.engine.render = real_render
+
+
+def test_server_deadline_maps_to_504_and_evicts(admission_app):
+    app, base, key_str = admission_app
+
+    real_render = app.engine.render
+    app.engine.render = lambda entry, poses: (
+        time.sleep(0.8), _ok_render(entry, poses)
+    )[1]
+    try:
+        # r1 occupies the worker; r2's deadline expires while queued
+        results: list[tuple[str, int]] = []
+        lock = threading.Lock()
+
+        def first():
+            c, _, _ = _render_req(base, key_str, timeout_s=5.0)
+            with lock:
+                results.append(("first", c))
+
+        t1 = threading.Thread(target=first)
+        t1.start()
+        time.sleep(0.15)  # let r1 reach the engine
+        c2, _, body2 = _render_req(base, key_str, timeout_s=0.3)
+        t1.join(timeout=60)
+        assert c2 == 504, body2
+        assert dict(results)["first"] == 200
+        assert (app.metrics.request_timeouts.value(stage="queue")
+                + app.metrics.request_timeouts.value(stage="result")) >= 1
+        # the timed-out request was evicted/expired, not left pending
+        assert app.batcher.queue_depth() == 0
+    finally:
+        app.engine.render = real_render
+
+
+def test_server_breaker_trips_degrades_healthz_and_recovers(admission_app):
+    app, base, key_str = admission_app
+
+    calls = {"n": 0}
+    real_render = app.engine.render
+
+    def failing_then_ok(entry, poses):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RuntimeError("device fell over")
+        return _ok_render(entry, poses)
+
+    app.engine.render = failing_then_ok
+    try:
+        # two consecutive engine failures: honest 500s, breaker trips
+        for _ in range(2):
+            c, _, _ = _render_req(base, key_str)
+            assert c == 500
+        assert app.breaker.state == "open"
+        assert app.metrics.breaker_trips.value() == 1
+        assert app.metrics.breaker_state.value() == 2
+        assert app.metrics.engine_failures.value(kind="render") == 2
+
+        # while open: immediate shed, no engine call; healthz degrades
+        c, h, _ = _render_req(base, key_str)
+        assert c == 503 and "Retry-After" in h
+        assert calls["n"] == 2  # the shed request never touched the engine
+        assert app.metrics.shed_requests.value(reason="breaker_open") >= 1
+        c, _, body = _http(base, "/healthz")
+        health = json.loads(body)
+        assert c == 503 and health["status"] == "degraded"
+        assert health["breaker"] == "open" and health["breaker_trips"] == 1
+
+        # after reset_after_s the breaker half-opens; /healthz must report
+        # HEALTHY (200 "recovering") — a 503 here would make a load
+        # balancer starve the breaker of its one recovery trial forever
+        time.sleep(0.5)
+        c, _, body = _http(base, "/healthz")
+        assert c == 200 and json.loads(body)["status"] == "recovering"
+        c, _, _ = _render_req(base, key_str)
+        assert c == 200
+        assert app.breaker.state == "closed"
+        c, _, body = _http(base, "/healthz")
+        assert c == 200 and json.loads(body)["status"] == "ok"
+        assert app.metrics.breaker_state.value() == 0
+    finally:
+        app.engine.render = real_render
+
+
+def test_server_drain_maps_to_503_not_500(admission_app):
+    app, base, key_str = admission_app
+    app.batcher.stop()
+    c, _, body = _render_req(base, key_str)
+    assert c == 503 and "draining" in json.loads(body)["error"]
+    assert app.metrics.shed_requests.value(reason="draining") == 1
+
+
+def test_engine_chaos_seam_raises_on_nth_render(admission_app):
+    """The engine_raise seam fires through the REAL engine entry point
+    (no monkeypatching) — the drill's breaker schedule depends on it."""
+    app, base, key_str = admission_app
+    chaos.install("engine_raise@render=2")
+    c1, _, _ = _render_req(base, key_str)  # fake entry: engine.render runs
+    # ... but the real engine would compile; so count seam calls directly
+    from mine_tpu.resilience.chaos import ChaosFault
+    from mine_tpu.serving.engine import RenderEngine
+
+    # second render call hits the injected fault at the seam before any
+    # bucket/compile work
+    with pytest.raises(ChaosFault):
+        RenderEngine.render(app.engine, _entry(), _poses())
+
+
+# --------------------------------------- sentinel: in-graph mask + exactness
+
+
+@pytest.fixture(scope="module")
+def tiny_train_setup():
+    """ONE compiled train step shared by the sentinel-mask and the
+    resume-exactness tests (the compile dominates their cost)."""
+    import jax
+
+    from mine_tpu.config import Config
+    from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.training import (
+        build_model,
+        init_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": 1,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2,
+        "resilience.sentinel_policy": "skip",
+    })
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    state0 = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    step_fn = jax.jit(make_train_step(cfg, model, tx))
+
+    def batch_at(i: int):
+        import jax.numpy as jnp
+
+        b = make_synthetic_batch(1, 128, 128, n_points=16, seed=100 + i)
+        b.pop("src_depth")
+        return {k: jnp.asarray(v) for k, v in b.items()}
+
+    return cfg, state0, step_fn, batch_at
+
+
+def _tree_equal(a, b) -> bool:
+    import jax
+
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(leaves_a, leaves_b)
+    )
+
+
+def test_sentinel_mask_drops_nonfinite_update_in_graph(tiny_train_setup):
+    """Acceptance: params provably unchanged by a poisoned step, while step
+    and RNG still advance (the stream moves past the bad batch)."""
+    import jax
+
+    cfg, state0, step_fn, batch_at = tiny_train_setup
+    state1, ld1 = step_fn(state0, batch_at(0))
+    assert float(ld1["update_skipped"]) == 0.0
+    assert np.isfinite(float(ld1["grad_norm"]))
+    assert not _tree_equal(state1.params, state0.params)  # a real update
+
+    poisoned = dict(batch_at(1))
+    poisoned["src_img"] = poisoned["src_img"] * float("nan")
+    state2, ld2 = step_fn(state1, poisoned)
+    assert not np.isfinite(float(ld2["loss"]))
+    assert float(ld2["update_skipped"]) == 1.0
+    host1, host2 = jax.device_get(state1), jax.device_get(state2)
+    assert _tree_equal(host2.params, host1.params)  # bitwise unchanged
+    assert _tree_equal(host2.opt_state, host1.opt_state)
+    assert _tree_equal(host2.batch_stats, host1.batch_stats)
+    assert int(host2.step) == int(host1.step) + 1  # streams still advance
+
+    # and the NEXT clean step trains normally from the protected params
+    state3, ld3 = step_fn(state2, batch_at(2))
+    assert float(ld3["update_skipped"]) == 0.0
+    assert np.isfinite(float(ld3["loss"]))
+
+
+def test_signal_triggered_save_restores_bitwise(tiny_train_setup, tmp_path):
+    """The resume-exactness satellite at the step level: train N vs
+    train k -> SIGUSR2-triggered out-of-band save -> restore -> train N-k,
+    asserting bitwise-equal params (one compile, real signal plumbing)."""
+    import jax
+
+    from mine_tpu.resilience.preempt import PreemptionGuard
+    from mine_tpu.training import checkpoint as ckpt
+
+    cfg, state0, step_fn, batch_at = tiny_train_setup
+    n, k = 6, 3
+
+    # reference: N uninterrupted steps
+    ref = state0
+    losses_ref = []
+    for i in range(n):
+        ref, ld = step_fn(ref, batch_at(i))
+        losses_ref.append(float(ld["loss"]))
+
+    # run A: k steps, then a SIGUSR2 save through the real guard
+    ws = str(tmp_path / "ws")
+    manager = ckpt.checkpoint_manager(ws)
+    live = {"state": state0}
+    for i in range(k):
+        live["state"], _ = step_fn(live["state"], batch_at(i))
+
+    def save(reason):
+        host = jax.device_get(live["state"])
+        ckpt.save(manager, host, int(host.step))
+        ckpt.wait_until_finished(manager)
+        ckpt.mark_last_good(ws, int(host.step))
+
+    guard = PreemptionGuard(save, signals=(signal.SIGUSR2,)).install()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+    finally:
+        guard.uninstall()
+    assert manager.latest_step() == k
+    assert ckpt.last_good_step(ws) == k
+
+    # run B: restore into a fresh template and finish the remaining steps
+    template = jax.device_get(state0)
+    restored, start = ckpt.restore(ckpt.checkpoint_manager(ws), template)
+    assert start == k
+    resumed = restored
+    losses_resumed = []
+    for i in range(k, n):
+        resumed, ld = step_fn(resumed, batch_at(i))
+        losses_resumed.append(float(ld["loss"]))
+
+    # bitwise-equal params AND a matching loss curve for the shared tail
+    assert _tree_equal(jax.device_get(resumed).params,
+                       jax.device_get(ref).params)
+    assert losses_resumed == losses_ref[k:]
+
+
+@pytest.mark.slow
+def test_trainer_rollback_restores_last_good(tmp_path):
+    """End-to-end rollback policy: a NaN trips the sentinel at a log
+    interval, the loop restores the last-good checkpoint, re-seeds the
+    data iterator at that position (mid-epoch), and completes the epoch."""
+    from mine_tpu.config import Config
+    from mine_tpu.data import SyntheticDataset
+    from mine_tpu.training import checkpoint as ckpt
+    from mine_tpu.training.loop import Trainer
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": 1,
+        "data.num_workers": 0,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2,
+        "training.epochs": 1,
+        "training.log_interval": 1,
+        "training.checkpoint_interval": 2,
+        "resilience.sentinel_policy": "rollback",
+    })
+    chaos.install("nan_loss@step=5")
+    ws = str(tmp_path / "ws")
+    trainer = Trainer(cfg, ws)
+    ds = SyntheticDataset(128, 128, trainer.global_batch, steps_per_epoch=6,
+                          n_points=32)
+    trainer.fit(ds)
+
+    # checkpoints at 2 and 4 preceded the step-5 NaN; the rollback restored
+    # step 4 and the replay (fault fires once) completed the epoch
+    assert ckpt.checkpoint_manager(ws).latest_step() == 6
+    assert ckpt.last_good_step(ws) == 6
+    s = trainer.sentinel
+    assert s.nonfinite_steps.value() == 1
+    assert s.skipped_updates.value() == 1
+    assert s.rollbacks.value() == 1
+    assert s.trips.value(reason="nonfinite", action="rollback") == 1
+
+
+def test_sentinel_spike_detection_and_abort_policy():
+    """Unit-level: the spike detector trips against the running median and
+    the abort policy raises SentinelAbort."""
+    import logging
+
+    from mine_tpu.config import Config
+    from mine_tpu.resilience.sentinel import SentinelAbort, TrainingSentinel
+    from mine_tpu.utils.metrics import MetricsRegistry
+
+    cfg = Config().replace(**{
+        "resilience.sentinel_policy": "abort",
+        "resilience.sentinel_spike_factor": 10.0,
+        "resilience.sentinel_spike_min_history": 3,
+    })
+    s = TrainingSentinel(cfg.resilience, MetricsRegistry(),
+                         logging.getLogger("test"))
+    for step, loss in enumerate((1.0, 1.1, 0.9, 1.0), start=1):
+        s.check(loss, step)
+    with pytest.raises(SentinelAbort, match="spike"):
+        s.check(50.0, 5)  # 50 > 10 x median(~1.0)
+    assert s.trips.value(reason="spike", action="abort") == 1
+
+    # non-finite host loss trips regardless of spike config
+    s2 = TrainingSentinel(cfg.resilience, MetricsRegistry(),
+                          logging.getLogger("test"))
+    with pytest.raises(SentinelAbort, match="nonfinite"):
+        s2.check(float("nan"), 1)
+
+
+def test_sentinel_vet_never_raises_and_defers_the_trip():
+    """The preemption-save path (signal handler) vets pending flags with
+    vet(): a bad verdict refuses the last-good blessing WITHOUT raising,
+    and the configured policy still trips at the next check() — a SIGUSR2
+    save-and-continue loses neither telemetry nor the rollback."""
+    import logging
+
+    import jax.numpy as jnp
+
+    from mine_tpu.config import Config
+    from mine_tpu.resilience.sentinel import SentinelRollback, TrainingSentinel
+    from mine_tpu.utils.metrics import MetricsRegistry
+
+    cfg = Config().replace(**{"resilience.sentinel_policy": "rollback"})
+    s = TrainingSentinel(cfg.resilience, MetricsRegistry(),
+                         logging.getLogger("test"))
+    s.observe(7, jnp.asarray(1.0))  # a masked non-finite step, unresolved
+    assert s.vet(9) is False        # refuses the blessing, raises nothing
+    assert s.nonfinite_steps.value() == 1  # telemetry not lost
+    assert s.vet(9) is False        # still bad until a check() consumes it
+    with pytest.raises(SentinelRollback):
+        s.check(1.0, 10)            # the deferred trip fires here
+    s.observe(11, jnp.asarray(0.0))
+    assert s.vet(11) is True        # clean flags vet clean again
+
+
+# ------------------------------------------------------------- drill smoke
+
+
+def test_chaos_drill_training_half_smoke(tmp_path):
+    """Tier-1 smoke of tools/chaos_drill.py's training half: nan-skip +
+    SIGTERM preemption save + mid-epoch auto-resume, asserted end-to-end
+    in subprocesses (the bitwise reference run is exercised by
+    test_signal_triggered_save_restores_bitwise above; --no-exact keeps
+    this inside the tier-1 budget)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_drill.py"),
+         "--half", "training", "--no-exact", "--steps", "5",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=repo,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    t = verdict["training"]
+    assert t["died_by_sigterm"] and t["checkpoint_after_sigterm"] == 4
+    assert t["sentinel_skip_logged"] and t["preempt_save_logged"]
+    assert t["resume_logged"] and t["mid_epoch_skip_logged"]
+    assert t["resumed_final_step"] == 5

@@ -220,13 +220,14 @@ def test_emergency_checkpoint_on_failure(tmp_path):
     manager = ckpt.checkpoint_manager(workspace)
     assert manager.latest_step() == 3  # the 3 completed steps survived
 
-    # and the next run resumes from there instead of step 0: the optimizer
-    # state continues from step 3 while the interrupted epoch's data replays
-    # (epoch-granular resume), so one full epoch lands at 3 + 8 = 11
+    # and the next run resumes from there instead of step 0 — at the exact
+    # mid-epoch data position (resilience PR: the first 3 batches of the
+    # interrupted epoch are skipped, not replayed), so the epoch completes
+    # at 8 total steps exactly as the uninterrupted run would have
     trainer2 = Trainer(cfg, workspace)
     ds_ok = SyntheticDataset(cfg.data.img_h, cfg.data.img_w, 8, steps_per_epoch=8)
     trainer2.fit(ds_ok)
-    assert ckpt.checkpoint_manager(workspace).latest_step() == 11
+    assert ckpt.checkpoint_manager(workspace).latest_step() == 8
 
 
 @pytest.mark.slow

@@ -1,0 +1,367 @@
+#!/usr/bin/env python3
+"""Chaos drill: prove the fault-tolerance layer end to end on CPU.
+
+Runs a short REAL training job and a REAL live server under a scripted
+fault schedule ($MINE_TPU_FAULTS, resilience/chaos.py) and asserts the end
+state, emitting exactly one JSON verdict line (bench.py discipline):
+
+Training half (two subprocesses against one workspace):
+  run 1  `nan_loss@step=2,sigterm@step=4` with sentinel policy "skip":
+         the poisoned step's update is dropped in-graph (sentinel warning
+         in train.log), SIGTERM triggers the preemption guard's
+         out-of-band save (checkpoint @ step 4 + last_good pointer) before
+         the process dies BY SIGTERM.
+  run 2  no new faults: auto-resumes from step 4, skips the 4
+         already-trained batches of the epoch (mid-epoch position
+         restore), completes to the full step count.
+  exactness (skippable with --no-exact): a third, uninterrupted run with
+         the SAME nan fault in a fresh workspace must end with BITWISE
+         identical params — the resume was exact, not approximate.
+
+Serving half (in-process live HTTP server over the trained checkpoint):
+  `engine_raise@render=...` faults trip the circuit breaker after
+  consecutive dispatch failures -> requests shed 503 + /healthz degraded
+  (503) -> half-open after breaker_reset_s -> a success closes it.
+  A concurrent flood against an artificially slowed engine with a tiny
+  queue bound + deadline must produce only 200/503/504 (shed + expired),
+  never a hang and never a 500, with the shed/timeout counters ticking.
+
+Usage:
+  python tools/chaos_drill.py [--half training|serving|all]
+                              [--workdir DIR] [--no-exact] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the smallest config the model architecture admits (H/W must be 128
+# multiples): every drill subprocess pays one tiny CPU compile
+TINY_OVERRIDES = {
+    "data.name": "synthetic",
+    "data.img_h": 128, "data.img_w": 128,
+    "data.per_gpu_batch_size": 1,
+    "data.num_workers": 0,
+    "model.num_layers": 18, "model.dtype": "float32",
+    "model.imagenet_pretrained": False,
+    "mpi.num_bins_coarse": 2,
+    "training.epochs": 1,
+    "training.log_interval": 1,
+    "training.checkpoint_interval": 1000,  # only the preempt save writes
+    "resilience.sentinel_policy": "skip",
+}
+
+_DRIVER = """\
+import json, sys
+sys.path.insert(0, {repo_root!r})
+from mine_tpu.utils.platform import honor_jax_platforms
+honor_jax_platforms()
+from mine_tpu.config import Config
+from mine_tpu.data import SyntheticDataset
+from mine_tpu.training.loop import Trainer
+
+overrides = json.loads(sys.argv[1])
+workspace, steps = sys.argv[2], int(sys.argv[3])
+cfg = Config().replace(**overrides)
+trainer = Trainer(cfg, workspace)
+ds = SyntheticDataset(
+    cfg.data.img_h, cfg.data.img_w, trainer.global_batch,
+    steps_per_epoch=steps, n_points=32,
+)
+trainer.fit(ds)
+"""
+
+
+def _run_training(workspace: str, steps: int, faults: str,
+                  timeout_s: float) -> subprocess.CompletedProcess:
+    driver = os.path.join(os.path.dirname(workspace), "_drill_driver.py")
+    with open(driver, "w") as fh:
+        fh.write(_DRIVER.format(repo_root=REPO_ROOT))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MINE_TPU_FAULTS=faults,
+               PYTHONPATH=REPO_ROOT)
+    return subprocess.run(
+        [sys.executable, driver, json.dumps(TINY_OVERRIDES), workspace,
+         str(steps)],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=timeout_s,
+    )
+
+
+def _log_text(workspace: str) -> str:
+    try:
+        with open(os.path.join(workspace, "train.log")) as fh:
+            return fh.read()
+    except OSError:
+        return ""
+
+
+def _params_of(workspace: str, step: int):
+    import orbax.checkpoint as ocp
+
+    from mine_tpu.training import checkpoint as ckpt
+
+    manager = ckpt.checkpoint_manager(workspace)
+    raw = manager.restore(step, args=ocp.args.StandardRestore())
+    return raw["params"]
+
+
+def training_half(workdir: str, steps: int, exact: bool,
+                  timeout_s: float) -> dict:
+    from mine_tpu.training import checkpoint as ckpt
+
+    ws = os.path.join(workdir, "ws")
+    result: dict = {"steps_per_epoch": steps}
+
+    # run 1: nan at step 2 (skip policy), SIGTERM after step 4
+    run1 = _run_training(ws, steps, "nan_loss@step=2,sigterm@step=4",
+                         timeout_s)
+    result["run1_returncode"] = run1.returncode
+    result["died_by_sigterm"] = run1.returncode == -signal.SIGTERM
+    log1 = _log_text(ws)
+    result["sentinel_skip_logged"] = "sentinel: non-finite" in log1
+    result["preempt_save_logged"] = "preemption save" in log1
+    manager = ckpt.checkpoint_manager(ws)
+    result["checkpoint_after_sigterm"] = (
+        manager.latest_step() if manager.latest_step() is not None else None
+    )
+    result["last_good"] = ckpt.last_good_step(ws)
+
+    # run 2: auto-resume to completion (no new faults)
+    run2 = _run_training(ws, steps, "", timeout_s)
+    result["run2_returncode"] = run2.returncode
+    log2 = _log_text(ws)
+    result["resume_logged"] = "resumed from step 4" in log2
+    result["mid_epoch_skip_logged"] = "mid-epoch resume: skipping 4" in log2
+    result["resumed_final_step"] = ckpt.checkpoint_manager(ws).latest_step()
+
+    ok = (
+        result["died_by_sigterm"]
+        and result["sentinel_skip_logged"]
+        and result["preempt_save_logged"]
+        and result["checkpoint_after_sigterm"] == 4
+        and result["last_good"] in (4, steps)
+        and result["run2_returncode"] == 0
+        and result["resume_logged"]
+        and result["mid_epoch_skip_logged"]
+        and result["resumed_final_step"] == steps
+    )
+
+    if exact and ok:
+        # run 3: uninterrupted, same nan fault, fresh workspace — the
+        # resumed run must be BITWISE identical to it
+        import numpy as np
+
+        ws_ref = os.path.join(workdir, "ws_ref")
+        run3 = _run_training(ws_ref, steps, "nan_loss@step=2", timeout_s)
+        result["ref_returncode"] = run3.returncode
+        if run3.returncode == 0:
+            import jax
+
+            mismatches = 0
+            resumed = _params_of(ws, steps)
+            reference = _params_of(ws_ref, steps)
+            for (path, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(resumed),
+                jax.tree_util.tree_leaves_with_path(reference),
+            ):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    mismatches += 1
+            result["bitwise_mismatched_leaves"] = mismatches
+            ok = ok and mismatches == 0
+        else:
+            ok = False
+
+    result["ok"] = ok
+    return result
+
+
+def serving_half(workdir: str, timeout_s: float) -> dict:
+    """Live HTTP server over the drill's checkpoint, scripted faults."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from mine_tpu.resilience import chaos
+    from mine_tpu.serving.server import ServingApp, make_server
+    from mine_tpu.training.checkpoint import load_for_serving
+
+    ws = os.path.join(workdir, "ws")
+    cfg, params, batch_stats, step = load_for_serving(
+        ws, allow_random_init=not os.path.isdir(os.path.join(ws, "checkpoints"))
+    )
+    app = ServingApp(
+        cfg, params, batch_stats, checkpoint_step=step,
+        max_delay_ms=0.0, request_timeout_s=30.0,
+        max_queue_requests=2, deadline_s=5.0,
+        breaker_failure_threshold=2, breaker_reset_s=1.0,
+    )
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    result: dict = {}
+    try:
+        def http(path, data=None, headers=None, timeout=timeout_s):
+            req = urllib.request.Request(base + path, data=data,
+                                         headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as err:
+                return err.code, err.read()
+
+        # a real predict (compiles once) seeds the cache
+        from PIL import Image
+        import io
+
+        from mine_tpu.data.synthetic import _intrinsics, _render_view
+        from mine_tpu.inference.video import to_uint8
+
+        img, _ = _render_view(128, 128, _intrinsics(128, 128),
+                              np.zeros(3), 0.7)
+        buf = io.BytesIO()
+        Image.fromarray(to_uint8(img)).save(buf, format="PNG")
+        code, body = http("/predict", data=buf.getvalue(),
+                          headers={"Content-Type": "image/png"})
+        result["predict_status"] = code
+        key = json.loads(body)["mpi_key"] if code == 200 else None
+
+        def render(timeout_body=None):
+            payload: dict = {"mpi_key": key, "offsets": [[0.01, 0.0, 0.0]]}
+            if timeout_body is not None:
+                payload["timeout_s"] = timeout_body
+            return http("/render", data=json.dumps(payload).encode(),
+                        headers={"Content-Type": "application/json"})
+
+        # 2 injected engine failures trip the breaker...
+        chaos.install("engine_raise@render=1,engine_raise@render=2")
+        fail_codes = [render()[0] for _ in range(2)]
+        result["engine_failure_codes"] = fail_codes  # 500s: real errors
+        code, body = http("/healthz")
+        result["healthz_degraded"] = (
+            code == 503 and json.loads(body)["status"] == "degraded"
+        )
+        shed_code, _ = render()
+        result["shed_while_open"] = shed_code == 503
+        # ...and it half-opens + recovers after breaker_reset_s
+        time.sleep(1.2)
+        recover_code, _ = render()
+        result["recovered_status"] = recover_code
+        code, body = http("/healthz")
+        result["healthz_recovered"] = (
+            code == 200 and json.loads(body)["status"] == "ok"
+        )
+
+        # overload flood: slow the engine down, tiny queue + deadlines —
+        # every answer must be 200/503/504, never a hang or a 500
+        chaos.uninstall()
+        real_render = app.engine.render
+
+        def slow_render(entry, poses):
+            time.sleep(0.4)
+            return real_render(entry, poses)
+
+        app.engine.render = slow_render
+        codes: list[int] = []
+        lock = threading.Lock()
+
+        def one(i):
+            c, _ = render(timeout_body=0.6)
+            with lock:
+                codes.append(c)
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout_s)
+        app.engine.render = real_render
+        result["flood_codes"] = sorted(codes)
+        result["flood_all_answered"] = len(codes) == 8
+        result["flood_no_500"] = all(c in (200, 503, 504) for c in codes)
+        result["flood_shed_or_expired"] = any(c in (503, 504) for c in codes)
+
+        text = app.metrics.render()
+
+        def metric(name):
+            for line in text.splitlines():
+                if line.startswith(name + "{") or line.startswith(name + " "):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+
+        result["shed_total"] = metric("mine_serve_shed_requests_total")
+        result["timeouts_total"] = metric("mine_serve_request_timeouts_total")
+        result["breaker_trips_total"] = metric("mine_serve_breaker_trips_total")
+        result["ok"] = (
+            result["predict_status"] == 200
+            and result["healthz_degraded"]
+            and result["shed_while_open"]
+            and result["recovered_status"] == 200
+            and result["healthz_recovered"]
+            and result["flood_all_answered"]
+            and result["flood_no_500"]
+            and result["flood_shed_or_expired"]
+            and result["breaker_trips_total"] >= 1
+        )
+    finally:
+        chaos.uninstall()
+        server.shutdown()
+        app.close()
+    return result
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--half", choices=("training", "serving", "all"),
+                        default="all")
+    parser.add_argument("--workdir", default=None,
+                        help="scratch dir (default: a fresh tempdir)")
+    parser.add_argument("--steps", type=int, default=6,
+                        help="steps per epoch for the drill training runs")
+    parser.add_argument("--no-exact", action="store_true",
+                        help="skip the third (bitwise-reference) training run")
+    parser.add_argument("--timeout-s", type=float, default=900.0,
+                        help="per-subprocess / per-request hard deadline")
+    args = parser.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_drill_")
+    os.makedirs(workdir, exist_ok=True)
+    verdict: dict = {"metric": "chaos_drill", "half": args.half,
+                     "workdir": workdir}
+    ok = True
+    try:
+        if args.half in ("training", "all"):
+            verdict["training"] = training_half(
+                workdir, args.steps, exact=not args.no_exact,
+                timeout_s=args.timeout_s,
+            )
+            ok = ok and verdict["training"]["ok"]
+        if args.half in ("serving", "all"):
+            verdict["serving"] = serving_half(workdir, args.timeout_s)
+            ok = ok and verdict["serving"]["ok"]
+        verdict["value"] = 1.0 if ok else None
+        verdict["ok"] = ok
+    except Exception as exc:  # noqa: BLE001 - the verdict IS the output
+        verdict.update(value=None, ok=False,
+                       error=f"{type(exc).__name__}: {exc}")
+        ok = False
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
