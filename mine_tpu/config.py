@@ -128,6 +128,13 @@ class TrainingConfig:
     src_rgb_blending: bool = True
     use_multi_scale: bool = True
     seed: int = 0
+    # gradient accumulation: the train step scans over `accum_steps`
+    # micro-batches (the per-device batch reshaped to (k, b/k, ...)),
+    # accumulating fp32 gradients before ONE optimizer update — peak
+    # activation memory is that of a single micro-batch, so the effective
+    # batch decouples from HBM (training/step.py). Must divide
+    # data.per_gpu_batch_size. 1 = the plain single-pass step.
+    accum_steps: int = 1
     log_interval: int = 10  # reference hardcodes 10 (synthesis_task.py:638)
     checkpoint_interval: int = 5000  # reference hardcodes 5000 (:645)
     lpips_weights_path: str = ""  # .npz from tools/convert_lpips.py
@@ -213,6 +220,23 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ParallelConfig:
+    """Parallelism strategy knobs beyond mesh LAYOUT (which stays in
+    mesh.*): how state is distributed over that mesh."""
+
+    # ZeRO-1 optimizer-state sharding (parallel/zero1.py): Adam moments
+    # partitioned over the data axis (each leaf split along its largest
+    # dividing dimension, small leaves replicated), updates computed on the
+    # local shard and all-gathered into the replicated params. Per-device
+    # optimizer-state bytes drop ~1/data_parallel; checkpoints stay
+    # layout-independent (gather-on-save, training/checkpoint.py).
+    zero1: bool = False
+    # leaves with fewer elements stay replicated (sharding a bias buys
+    # nothing and costs an all_gather launch)
+    zero1_min_size: int = 1024
+
+
+@dataclass(frozen=True)
 class Config:
     data: DataConfig = field(default_factory=DataConfig)
     lr: LRConfig = field(default_factory=LRConfig)
@@ -221,6 +245,7 @@ class Config:
     loss: LossConfig = field(default_factory=LossConfig)
     training: TrainingConfig = field(default_factory=TrainingConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 

@@ -14,7 +14,10 @@ from mine_tpu.parallel.data_parallel import (
     make_parallel_eval_step,
     model_axes,
     replicate_state,
+    distribute_state,
+    zero1_enabled,
 )
+from mine_tpu.parallel import zero1
 from mine_tpu.parallel.plane_sharding import (
     plane_compositor,
     sharded_alpha_composition,

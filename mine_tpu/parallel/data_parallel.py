@@ -14,7 +14,7 @@ from typing import Callable
 
 import jax
 import optax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mine_tpu.utils.jax_compat import shard_map
 
@@ -22,6 +22,7 @@ from mine_tpu.config import Config
 from mine_tpu.models import MPINetwork
 from mine_tpu.ops import compositor_from_config
 from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS
+from mine_tpu.parallel import zero1
 from mine_tpu.parallel.plane_sharding import plane_compositor
 from mine_tpu.training.step import make_eval_step, make_train_step
 from mine_tpu.training.state import TrainState
@@ -74,8 +75,34 @@ def _plane_args(cfg: Config, mesh: Mesh) -> dict:
     }
 
 
+def zero1_enabled(cfg: Config, mesh: Mesh) -> bool:
+    """Whether ZeRO-1 actually runs: the knob is on AND there is something
+    to shard over — on a 1-wide data axis the "shard" is the whole state
+    and the layout degrades to replicated. The one definition of the
+    degrade rule: distribute_state, the step builder, and the Trainer's
+    opt_layout.json sidecar all consult it, so what the sidecar records is
+    by construction what was placed."""
+    return bool(cfg.parallel.zero1) and mesh.shape[DATA_AXIS] > 1
+
+
+def _state_specs(cfg: Config, mesh: Mesh, state: TrainState | None):
+    """shard_map PartitionSpecs for the TrainState: a bare P() (replicated,
+    prefix-matched over the whole pytree) unless ZeRO-1 is on — then
+    zero1.state_specs, the SAME layout rule distribute_state places by, so
+    the compiled step and the live placement cannot diverge."""
+    if state is None or not zero1_enabled(cfg, mesh):
+        return _REPL
+    return zero1.state_specs(
+        state, mesh.shape[DATA_AXIS], cfg.parallel.zero1_min_size
+    )
+
+
 def make_parallel_train_step(
-    cfg: Config, model: MPINetwork, tx: optax.GradientTransformation, mesh: Mesh
+    cfg: Config,
+    model: MPINetwork,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state: TrainState | None = None,
 ) -> Callable:
     """jit(shard_map(train_step)): state replicated, batch sharded over
     `data` and replicated over `plane`; with a plane axis of size > 1, each
@@ -85,17 +112,44 @@ def make_parallel_train_step(
     The model must have been built with axis_name=model_axis_name(mesh)
     (build_model) so BN stats sync; the step pmeans the loss pre-grad over
     `data` and logged losses post-grad (step.py).
+
+    BOTH arguments are donated: the state is consumed and returned every
+    step, and the batch's device buffers are dead the moment the step has
+    read them — the prefetch pipeline transfers a FRESH batch each step
+    (training/loop.py staged_batches), so holding the old one alive only
+    padded peak HBM by one full batch.
+
+    With `parallel.zero1` (and a data axis wider than 1), pass the
+    replicated-or-host `state` template: the optimizer-state leaves get
+    data-axis PartitionSpecs (parallel/zero1.py) in both in_ and out_specs,
+    and the step computes updates on the local moment shard + all_gather
+    (training/step.py apply_update). `distribute_state` must have placed
+    the live state with the matching layout.
     """
+    use_zero1 = zero1_enabled(cfg, mesh)
+    if use_zero1 and state is None:
+        raise ValueError(
+            "parallel.zero1 needs the state template to derive the "
+            "opt-state partition specs: make_parallel_train_step(..., "
+            "state=state)"
+        )
+    dims = None
+    if use_zero1:
+        dims = zero1.tree_partition_dims(
+            state.params, mesh.shape[DATA_AXIS], cfg.parallel.zero1_min_size
+        )
     step = make_train_step(
-        cfg, model, tx, axis_name=DATA_AXIS, **_plane_args(cfg, mesh)
+        cfg, model, tx, axis_name=DATA_AXIS, zero1_dims=dims,
+        **_plane_args(cfg, mesh),
     )
+    specs = _state_specs(cfg, mesh, state)
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(_REPL, _BATCH),
-        out_specs=(_REPL, _REPL),
+        in_specs=(specs, _BATCH),
+        out_specs=(specs, _REPL),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    return jax.jit(sharded, donate_argnums=(0, 1))
 
 
 def make_parallel_eval_step(
@@ -103,9 +157,18 @@ def make_parallel_eval_step(
     model: MPINetwork,
     mesh: Mesh,
     lpips_params: dict | None = None,
+    state: TrainState | None = None,
 ) -> Callable:
     """jit(shard_map(eval_step)): losses pmean'd to replicated; per-replica
-    visualizations stay batch-sharded (gather only what gets logged)."""
+    visualizations stay batch-sharded (gather only what gets logged).
+
+    The eval body reads only params/batch_stats, but it is handed the whole
+    TrainState — under `parallel.zero1`, pass the same `state` template as
+    the train step so the opt-state leaves keep their data-axis specs
+    through shard_map. A replicated in_spec would make jit all-gather the
+    sharded Adam moments onto every device on each eval call, spiking HBM
+    right back to the replicated footprint the sharding exists to remove;
+    with the matching specs the unused shards just flow through."""
     step = make_eval_step(
         cfg, model, lpips_params=lpips_params, axis_name=DATA_AXIS,
         **_plane_args(cfg, mesh),
@@ -113,7 +176,7 @@ def make_parallel_eval_step(
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(_REPL, _BATCH, _REPL),
+        in_specs=(_state_specs(cfg, mesh, state), _BATCH, _REPL),
         out_specs=(_REPL, _BATCH),
     )
     return jax.jit(sharded)
@@ -122,4 +185,17 @@ def make_parallel_eval_step(
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place the train state replicated on every mesh device (the DDP initial
     param broadcast, synthesis_task.py:110-115, done once, explicitly)."""
-    return jax.device_put(state, jax.sharding.NamedSharding(mesh, _REPL))
+    return jax.device_put(state, NamedSharding(mesh, _REPL))
+
+
+def distribute_state(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
+    """Place a (host or replicated) TrainState per the configured layout:
+    fully replicated, or — under `parallel.zero1` — params/BN replicated
+    with the optimizer state sharded over `data` (parallel/zero1.py).
+
+    The single entry point for every placement in the training loop
+    (initial, warm start, rollback restore), so a restored checkpoint —
+    always saved gathered/layout-free — lands back in the live layout."""
+    if not zero1_enabled(cfg, mesh):
+        return replicate_state(state, mesh)
+    return zero1.place_state(state, mesh, cfg.parallel.zero1_min_size)

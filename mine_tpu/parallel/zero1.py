@@ -1,0 +1,187 @@
+"""ZeRO-1 optimizer-state sharding over the data mesh axis.
+
+The reference replicates Adam moments on every DDP rank (torch Adam holds
+exp_avg/exp_avg_sq per param, synthesis_task.py:85-89), and so did this repo
+until now: with ~60M params the moments are 2x params bytes of pure
+replication on every device. ZeRO-1 (Rajbhandari et al., arXiv 1910.02054,
+stage 1) removes it: each data-parallel device owns a 1/n shard of the
+optimizer state, computes the parameter UPDATE for its shard only, and an
+all_gather reassembles the full update into the (still replicated) params.
+Gradients are reduced exactly once, same as plain data parallel — the only
+added traffic is the update all_gather, which replaces the redundant
+(n-1)/n of the optimizer math every device used to repeat.
+
+Partitioning rule (`partition_dim`): each leaf is split along its largest
+dimension that divides the axis size; leaves smaller than
+`parallel.zero1_min_size` elements (biases, scalars, schedule counts) stay
+replicated — the epsilon in the ~1/n per-device-bytes claim. The rule is a
+pure function of the leaf SHAPE, so a param leaf, its gradient, and its
+Adam moments (same shape by construction) always agree on the split, and
+no name-based matching between the param tree and optax's state tree is
+needed.
+
+The optimizer chain this repo uses (add_decayed_weights, scale_by_adam,
+scale_by_learning_rate under multi_transform) is elementwise per leaf, so
+update(slice(g), shard_state, slice(p)) == slice(update(g, state, p)) and
+the sharded update is EXACT, not approximate (tests/test_parallel.py
+mesh-equivalence). A cross-leaf transform (e.g. global-norm clipping)
+would break that identity; `make_optimizer` has none.
+
+Checkpoints stay layout-independent: `jax.device_get` of a sharded array
+materializes the full global array (gather-on-save), so saved opt state is
+always the replicated layout and restores into either placement
+(`place_state` re-shards). The layout that produced a workspace is
+recorded in the sidecar (training/checkpoint.py `record_opt_layout`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mine_tpu.parallel.mesh import DATA_AXIS
+from mine_tpu.utils.jax_compat import axis_size
+
+REPLICATED = -1  # sentinel partition dim: leaf stays whole on every device
+
+
+def partition_dim(shape: tuple[int, ...], n_shards: int, min_size: int) -> int:
+    """Which dimension of a leaf to split over the data axis, or REPLICATED.
+
+    Dimensions are tried largest-first (the issue of splitting the largest
+    dim is skew: a (3,3,16,2048) conv kernel splits its 2048, not the 3);
+    the first one divisible by n_shards wins. Leaves under min_size
+    elements, scalars, and leaves with no dividing dimension replicate.
+    """
+    if not shape or n_shards <= 1:
+        return REPLICATED
+    if math.prod(shape) < min_size:
+        return REPLICATED
+    for d in sorted(range(len(shape)), key=lambda i: shape[i], reverse=True):
+        if shape[d] % n_shards == 0 and shape[d] >= n_shards:
+            return d
+    return REPLICATED
+
+
+def tree_partition_dims(tree: Any, n_shards: int, min_size: int) -> Any:
+    """partition_dim per leaf (ints, REPLICATED sentinel — never None, which
+    jax.tree.map would treat as an empty subtree)."""
+    return jax.tree.map(
+        lambda leaf: partition_dim(np.shape(leaf), n_shards, min_size), tree
+    )
+
+
+def _spec(dim: int) -> P:
+    return P() if dim == REPLICATED else P(*([None] * dim + [DATA_AXIS]))
+
+
+def opt_state_specs(opt_state: Any, n_shards: int, min_size: int) -> Any:
+    """PartitionSpec per opt-state leaf under the shape rule: Adam moments
+    land on the same split as their param (same shape), scalar counts and
+    small leaves replicate."""
+    return jax.tree.map(
+        lambda leaf: _spec(partition_dim(np.shape(leaf), n_shards, min_size)),
+        opt_state,
+    )
+
+
+def state_specs(state: Any, n_shards: int, min_size: int) -> Any:
+    """Bare PartitionSpec tree for a TrainState under ZeRO-1 — THE layout
+    rule, stated once: opt_state leaves shard over `data` per the shape
+    rule, everything else replicates. Both consumers derive from here, so
+    the compiled step's in/out_specs (data_parallel.make_parallel_train_step
+    via _state_specs) and the live placement (state_shardings → place_state)
+    cannot diverge."""
+    repl_tree = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
+    return state.replace(
+        step=P(),
+        params=repl_tree(state.params),
+        batch_stats=repl_tree(state.batch_stats),
+        opt_state=opt_state_specs(state.opt_state, n_shards, min_size),
+        rng=P(),
+    )
+
+
+def state_shardings(state: Any, mesh: Mesh, min_size: int) -> Any:
+    """NamedSharding pytree for a TrainState under ZeRO-1: state_specs
+    bound to the mesh. Feed to jax.device_put (place_state)."""
+    specs = state_specs(state, mesh.shape[DATA_AXIS], min_size)
+    # PartitionSpec is a tuple subclass, i.e. itself a pytree — stop the
+    # traversal at spec leaves or tree.map would recurse into them
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def place_state(state: Any, mesh: Mesh, min_size: int) -> Any:
+    """device_put a (host or replicated) TrainState into the ZeRO-1 layout.
+
+    The inverse direction needs no helper: jax.device_get of the placed
+    state returns full global arrays (this is what makes checkpoints
+    layout-independent — training/checkpoint.py gather-on-save)."""
+    return jax.device_put(state, state_shardings(state, mesh, min_size))
+
+
+def shard_update(
+    tx: Any,
+    grads: Any,
+    opt_state_local: Any,
+    params: Any,
+    dims: Any,
+    axis_name: str = DATA_AXIS,
+) -> tuple[Any, Any]:
+    """The ZeRO-1 optimizer step, called INSIDE shard_map with fully
+    reduced (replicated-in-value) grads and params, and the LOCAL shard of
+    the optimizer state.
+
+    Each device slices its chunk of every partitioned grad/param leaf,
+    runs tx.update on the shard (exact — the chain is elementwise), and
+    all_gathers the update chunks back into full update leaves; replicated
+    leaves compute identically everywhere and skip both steps. Returns
+    (full updates, new LOCAL opt state).
+    """
+    idx = lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+
+    def slc(x, d):
+        if d == REPLICATED:
+            return x
+        chunk = x.shape[d] // n
+        return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=d)
+
+    grads_local = jax.tree.map(slc, grads, dims)
+    params_local = jax.tree.map(slc, params, dims)
+    updates_local, new_opt_local = tx.update(
+        grads_local, opt_state_local, params_local
+    )
+
+    def gather(u, d):
+        if d == REPLICATED:
+            return u
+        return lax.all_gather(u, axis_name, axis=d, tiled=True)
+
+    updates = jax.tree.map(gather, updates_local, dims)
+    return updates, new_opt_local
+
+
+def per_device_bytes(tree: Any, device: Any | None = None) -> int:
+    """Bytes of `tree` resident on one device — the measurement behind the
+    "~1/n opt-state bytes" claim (tools/bench_accum.py, test_parallel).
+    Sharded leaves count only the local shard; replicated leaves count
+    their full size; host arrays count as one replica."""
+    if device is None:
+        device = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None:
+            total += sum(s.data.nbytes for s in shards if s.device == device)
+        else:
+            total += np.asarray(leaf).nbytes
+    return total

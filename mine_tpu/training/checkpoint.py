@@ -129,6 +129,45 @@ def wait_until_finished(manager: ocp.CheckpointManager) -> None:
     manager.wait_until_finished()
 
 
+# -- optimizer-state layout sidecar (parallel/zero1.py) ------------------------
+#
+# Checkpoints themselves are LAYOUT-INDEPENDENT: every save path goes
+# through jax.device_get, which gathers sharded leaves into full global
+# arrays — so a checkpoint written under ZeRO-1 restores into a replicated
+# run and vice versa, and the last_good/rollback machinery never has to
+# know how the optimizer state was placed. The sidecar records what
+# produced the workspace anyway, so tooling (and the next resume) can see
+# which layout a run trained under and re-place accordingly.
+
+
+def _opt_layout_path(workspace: str) -> str:
+    return os.path.join(local_sidecar_dir(workspace), "opt_layout.json")
+
+
+def record_opt_layout(workspace: str, layout: dict) -> None:
+    """Atomically record the optimizer-state layout of this run, e.g.
+    {"zero1": true, "data_parallel": 8, "zero1_min_size": 1024,
+    "gathered_on_save": true}. Same atomic-rename discipline as
+    mark_last_good, and for the same reason: a preemption mid-write must
+    leave old-or-new, never half."""
+    path = _opt_layout_path(workspace)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(dict(layout, gathered_on_save=True), fh)
+    os.replace(tmp, path)
+
+
+def opt_layout(workspace: str) -> dict | None:
+    """The recorded layout, or None for pre-zero1 workspaces (which are by
+    construction replicated + gathered — the only layout that existed)."""
+    try:
+        with open(_opt_layout_path(workspace)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
 # -- last-good pointer (resilience/sentinel.py rollback target) ---------------
 
 

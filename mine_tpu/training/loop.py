@@ -19,6 +19,7 @@ none of it costs anything.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import signal
 import time
@@ -41,13 +42,14 @@ from mine_tpu.obs.cost import (
 )
 from mine_tpu.parallel import (
     DATA_AXIS,
+    distribute_state,
     init_multihost,
     make_mesh,
     make_parallel_eval_step,
     make_parallel_train_step,
     model_axes,
-    replicate_state,
     shard_batch,
+    zero1_enabled,
 )
 from mine_tpu.resilience import (
     PreemptedError,
@@ -139,6 +141,23 @@ class TrainObsMetrics:
             "host batches retried after transient loader/staging errors "
             "(data.loader_retries)",
         )
+        self.accum_steps = r.gauge(
+            "mine_train_accum_steps",
+            "micro-batches accumulated per optimizer update "
+            "(training.accum_steps)",
+        )
+        self.effective_batch = r.gauge(
+            "mine_train_effective_batch",
+            "examples per optimizer UPDATE across the whole mesh "
+            "(per_gpu_batch_size x data_parallel; accumulation splits it "
+            "into micro-batches, it does not multiply it)",
+        )
+        self.micro_step_flops = r.gauge(
+            "mine_train_flops_per_micro_step",
+            "step_flops / accum_steps: FLOPs of one micro-batch "
+            "forward+backward (step_flops stays per UPDATE — the two "
+            "gauges exist so neither is double-counted into the other)",
+        )
 
 
 class Trainer:
@@ -182,10 +201,35 @@ class Trainer:
             flight=self.flight,
         )
         self.model = build_model(cfg, **model_axes(self.mesh))
+        # effective batch PER UPDATE. Accumulation splits each device's
+        # batch into accum_steps micro-batches inside the step; it never
+        # multiplies the loader batch, so throughput (imgs/sec) and the
+        # effective-batch gauge both stay per-update quantities.
         self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
+        self.accum_steps = max(int(cfg.training.accum_steps), 1)
+        if cfg.data.per_gpu_batch_size % self.accum_steps:
+            raise ValueError(
+                f"training.accum_steps={self.accum_steps} must divide "
+                f"data.per_gpu_batch_size={cfg.data.per_gpu_batch_size} "
+                "(the per-device batch reshapes to (k, b/k, ...))"
+            )
+        self.obs_metrics.accum_steps.set(self.accum_steps)
+        self.obs_metrics.effective_batch.set(self.global_batch)
+        # the SAME predicate distribute_state places by (a 1-wide data axis
+        # degrades the knob to replicated), so the sidecar below records
+        # what actually runs
+        self.zero1 = zero1_enabled(cfg, self.mesh)
         if jax.process_index() == 0:
             os.makedirs(self.local_dir, exist_ok=True)
             ckpt.save_paired_config(cfg, self.local_dir)
+            # layout sidecar: checkpoints themselves are gathered/layout-free
+            # (training/checkpoint.py), this records what produced the run so
+            # a resume/rollback can re-place into the live layout knowingly
+            ckpt.record_opt_layout(self.workspace, {
+                "zero1": self.zero1,
+                "data_parallel": self.mesh.shape[DATA_AXIS],
+                "zero1_min_size": cfg.parallel.zero1_min_size,
+            })
             if self.local_dir != workspace:
                 self.logger.info(
                     "workspace %s is remote: checkpoints go there via orbax; "
@@ -265,11 +309,19 @@ class Trainer:
                 self.logger.info(
                     "warm-started from %s @ step %d", warm_path, warm_step
                 )
-        state = replicate_state(state, self.mesh)
+        # single placement entry point: replicated, or — under
+        # parallel.zero1 — opt state sharded over `data` (parallel/zero1.py).
+        # Restores always pass through here, so a gathered (layout-free)
+        # checkpoint lands back in the live layout.
+        state = distribute_state(state, cfg, self.mesh)
 
         lpips_params = load_lpips_params(cfg.training.lpips_weights_path)
-        train_step = make_parallel_train_step(cfg, self.model, tx, self.mesh)
-        eval_step = make_parallel_eval_step(cfg, self.model, self.mesh, lpips_params)
+        train_step = make_parallel_train_step(
+            cfg, self.model, tx, self.mesh, state=state
+        )
+        eval_step = make_parallel_eval_step(
+            cfg, self.model, self.mesh, lpips_params, state=state
+        )
 
         meters = {k: AverageMeter(k) for k in LOSS_KEYS}
         start_epoch = start_step // steps_per_epoch + 1
@@ -391,6 +443,27 @@ class Trainer:
         except OSError:
             self.logger.exception("host trace export failed")
 
+    def _per_update_cost(self, cost):
+        """Normalize an executable's StepCost to per-UPDATE figures.
+
+        XLA's cost_analysis counts a while/scan body ONCE — the trip count
+        is opaque to it — so under accumulation the raw flops/bytes of the
+        compiled step are ~one MICRO-batch forward+backward (plus the
+        reduce/optimizer epilogue), not the k the executable actually runs
+        (tools/bench_accum.py shows raw flops flat in k at equal effective
+        batch). The MFU/bandwidth gauges divide by per-update wall time, so
+        scale by accum_steps here; the epilogue gets over-counted k-fold,
+        a <~1% error at real model sizes. peak_memory_bytes is a max, not
+        a sum — it stays untouched."""
+        if self.accum_steps <= 1:
+            return cost
+        scale = lambda v: v * self.accum_steps if v else v  # noqa: E731
+        return dataclasses.replace(
+            cost,
+            flops=scale(cost.flops),
+            bytes_accessed=scale(cost.bytes_accessed),
+        )
+
     def _prepare_cost_accounting(self, train_step, state, batch):
         """AOT-compile the train step once (jit would compile the same HLO
         anyway — this just makes the Compiled handle inspectable), pull
@@ -401,7 +474,7 @@ class Trainer:
         try:
             with self.tracer.span("aot_compile", cat="train"):
                 compiled = train_step.lower(state, batch).compile()
-            self._train_cost = compiled_cost(compiled)
+            self._train_cost = self._per_update_cost(compiled_cost(compiled))
             self._compiled_train_step = compiled
         except Exception:  # noqa: BLE001 - backend-dependent surface
             self.logger.exception(
@@ -414,7 +487,13 @@ class Trainer:
         )
         self._peak_hbm = resolve_peak_hbm_bytes(jax.devices()[0])
         if self._train_cost.flops:
+            # _train_cost is per UPDATE (_per_update_cost); the micro gauge
+            # is the division back down — never a second cost_analysis that
+            # could double-count against it
             self.obs_metrics.step_flops.set(self._train_cost.flops)
+            self.obs_metrics.micro_step_flops.set(
+                self._train_cost.flops / self.accum_steps
+            )
             self.writer.scalar(
                 "obs/step_flops", self._train_cost.flops, int(state.step)
             )
@@ -491,7 +570,7 @@ class Trainer:
                     "re-seeding the data iterator there", rollbacks, trip,
                     restored,
                 )
-                state = replicate_state(host_state, self.mesh)
+                state = distribute_state(host_state, self.cfg, self.mesh)
                 self._live_state = state
                 global_step = restored
                 self.sentinel.reset_after_rollback()

@@ -533,6 +533,7 @@ def make_train_step(
     axis_name: str | None = None,
     plane_axis: str | None = None,
     compositor: ops.Compositor | None = None,
+    zero1_dims: Any | None = None,
 ) -> Callable[[TrainState, dict[str, Array]], tuple[TrainState, dict[str, Array]]]:
     """Build the train-step function (one optimizer update,
     synthesis_task.py:627-635 under jit).
@@ -553,6 +554,34 @@ def make_train_step(
     them into the exact full-S gradient (a plane pmean would shrink it by
     the plane count).
 
+    With `training.accum_steps` = k > 1, ONE update is computed from k
+    sequential micro-batches: the per-device batch (b, ...) reshapes to
+    (k, b/k, ...) and a lax.scan runs the forward+backward on each
+    micro-batch, accumulating gradients in fp32. Peak activation memory is
+    that of a SINGLE micro-batch (the scan serializes the per-micro
+    forward+backwards; nothing lives across iterations but the fp32
+    accumulator + BN stats carry), so effective batch decouples from HBM
+    (tools/bench_accum.py measures the claim). Numerics: equal-size
+    micro-batches make mean-of-micro-means == the full-batch mean, so at
+    fp32 accumulation is a numerics no-op up to summation order
+    (PARITY.md). BN-stats policy: SEQUENTIAL (running) — the stats carry
+    threads through the scan, so every micro-batch contributes exactly as
+    k separate steps would have; each micro-batch normalizes by its OWN
+    batch moments (synced over the mesh as always), which is the one
+    deliberate deviation from a monolithic step's full-batch moments
+    (tests/test_accum.py pins both properties). The RNG folds the
+    micro-step index so disparity sampling/dropout stay i.i.d. across
+    micro-batches. Per-micro-step finiteness flags AND-reduce (and pmean
+    to a mesh-consistent verdict) so a single poisoned micro-batch masks
+    the whole update bitwise, exactly as a poisoned batch does at k=1.
+
+    With `zero1_dims` (the per-leaf partition dims from
+    parallel/zero1.py, requires `axis_name`), the optimizer update runs
+    ZeRO-1: `state.opt_state` holds this device's SHARD of the Adam
+    moments, the update is computed on the shard from the (replicated,
+    already-reduced) grads, and an all_gather reassembles the full update
+    — grads are still reduced exactly once.
+
     Sentinel instrumentation (resilience/sentinel.py): the returned
     loss_dict always carries `grad_norm` (the post-reduction global
     gradient norm) and `update_skipped`. With any
@@ -565,15 +594,18 @@ def make_train_step(
     if compositor is None:
         compositor = ops.compositor_from_config(cfg)
     sentinel_mask = cfg.resilience.sentinel_policy != "off"
+    accum = max(int(cfg.training.accum_steps), 1)
+    if zero1_dims is not None and axis_name is None:
+        raise ValueError("ZeRO-1 shards over the data axis: axis_name is "
+                         "required when zero1_dims is given")
 
-    def train_step(state: TrainState, batch: dict[str, Array]):
-        rng = jax.random.fold_in(state.rng, state.step)
-        if axis_name is not None:
-            rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+    def micro_grads(params, batch_stats, batch, rng):
+        """Forward + backward of one (micro-)batch: the unit both the
+        single-pass and the accumulating step build on."""
 
-        def loss_fn(params):
+        def loss_fn(p):
             total, loss_dict, _viz, new_stats = loss_fcn(
-                cfg, model, params, state.batch_stats, batch, rng,
+                cfg, model, p, batch_stats, batch, rng,
                 is_val=False, train=True,
                 plane_axis=plane_axis, compositor=compositor,
             )
@@ -588,30 +620,127 @@ def make_train_step(
                 total = lax.pmean(total, axis_name)
             return total, (loss_dict, new_stats)
 
-        grads, (loss_dict, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
+        return jax.grad(loss_fn, has_aux=True)(params)
+
+    def reduce_grads(grads):
         if not has_vma():
             # Pre-vma shard_map (jax 0.4.x) has none of the
-            # replicated-cotangent machinery the docstring above describes:
+            # replicated-cotangent machinery described in micro_grads:
             # there each device's grad carries only its own shard's
             # contribution, so the reduction is explicit — MEAN over the
             # data axis (each replica grads its local-batch mean; this is
             # the DDP allreduce) and SUM over the plane axis (each device
             # owns its S_local planes' slice of the full-S gradient).
             # On vma jax both reductions happen inside AD and these would
-            # double-count — hence the version gate.
+            # double-count — hence the version gate. Under accumulation
+            # this runs ONCE on the fp32 accumulator, not per micro-step:
+            # the "grads psum'd once" half of the microbatching contract.
             if axis_name is not None:
                 grads = lax.pmean(grads, axis_name)
             if plane_axis is not None:
                 grads = lax.psum(grads, plane_axis)
+        return grads
+
+    def apply_update(grads, opt_state, params):
+        if zero1_dims is not None:
+            # function-level import: mine_tpu.parallel imports this module
+            from mine_tpu.parallel.zero1 import shard_update
+
+            return shard_update(tx, grads, opt_state, params, zero1_dims,
+                                axis_name)
+        return tx.update(grads, opt_state, params)
+
+    def accumulate(state: TrainState, batch: dict[str, Array], rng: Array):
+        """k micro-steps -> (mean fp32 grads, mean loss_dict, final BN
+        stats, AND-of-micro finiteness), all pre-reduction."""
+        b = jax.tree.leaves(batch)[0].shape[0]
+        if b % accum:
+            raise ValueError(
+                f"training.accum_steps={accum} must divide the per-device "
+                f"batch size {b} (batch reshapes to (k, b/k, ...))"
+            )
+        micro = jax.tree.map(
+            lambda x: x.reshape((accum, b // accum) + x.shape[1:]), batch
+        )
+
+        def body(carry, xs):
+            acc, stats = carry
+            mb, i = xs
+            # i.i.d. sampling per micro-batch: an unfolded key would give
+            # every micro-batch the same disparity draw / dropout mask
+            grads, (loss_dict, new_stats) = micro_grads(
+                state.params, stats, mb, jax.random.fold_in(rng, i)
+            )
+            # the per-micro flag catches poison the final post-reduction
+            # check could in principle miss (e.g. inf micro-grads cancelling
+            # across micro-batches); it AND-reduces below
+            finite = jnp.isfinite(loss_dict["loss"]) & jnp.isfinite(
+                optax.global_norm(grads)
+            )
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads
+            )
+            return (acc, new_stats), (loss_dict, finite)
+
+        # the scan IS the memory contract: it serializes the k
+        # forward+backwards (jax.grad runs inside the body) and nothing
+        # lives across iterations beyond the carry (fp32 accumulator + BN
+        # stats), so peak activation memory is ONE micro-batch's —
+        # tools/bench_accum.py measures exactly that. jax.checkpoint
+        # lowers as a no-op today (nothing differentiates THROUGH this
+        # scan); it is armed in case an outer grad ever does
+        body = jax.checkpoint(body)
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (acc, new_stats), (loss_dicts, finite_flags) = lax.scan(
+            body, (zeros, state.batch_stats), (micro, jnp.arange(accum))
+        )
+        grads = jax.tree.map(lambda a: a / accum, acc)
+        # equal-size micro-batches: mean over k of per-micro batch means ==
+        # the full-batch mean, for every (decomposable) logged term
+        loss_dict = jax.tree.map(lambda v: jnp.mean(v, axis=0), loss_dicts)
+        return grads, loss_dict, new_stats, jnp.all(finite_flags)
+
+    def train_step(state: TrainState, batch: dict[str, Array]):
+        rng = jax.random.fold_in(state.rng, state.step)
+        if axis_name is not None:
+            rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+        if accum > 1:
+            grads, loss_dict, new_stats, micro_finite = accumulate(
+                state, batch, rng
+            )
+            # the per-micro AND is computed from LOCAL losses/grads and can
+            # disagree across devices (a NaN poisons one shard's flags
+            # before any collective) — pmean it into one mesh-wide verdict
+            # so the update mask below stays bitwise-identical everywhere
+            micro_finite = micro_finite.astype(jnp.float32)
+            if axis_name is not None:
+                micro_finite = lax.pmean(micro_finite, axis_name)
+            if plane_axis is not None:
+                micro_finite = lax.pmean(micro_finite, plane_axis)
+            micro_finite = micro_finite == 1.0
+        else:
+            grads, (loss_dict, new_stats) = micro_grads(
+                state.params, state.batch_stats, batch, rng
+            )
+            micro_finite = jnp.asarray(True)
+        grads = reduce_grads(grads)
         if axis_name is not None:
             loss_dict = lax.pmean(loss_dict, axis_name)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        updates, new_opt_state = apply_update(
+            grads, state.opt_state, state.params
+        )
         new_params = optax.apply_updates(state.params, updates)
         # post-reduction, so every replica computes the identical norm and
         # the identical finite verdict (a NaN anywhere pmean-poisons all)
         grad_norm = optax.global_norm(grads)
         loss_dict["grad_norm"] = grad_norm
-        finite = jnp.isfinite(loss_dict["loss"]) & jnp.isfinite(grad_norm)
+        finite = (
+            jnp.isfinite(loss_dict["loss"]) & jnp.isfinite(grad_norm)
+            & micro_finite
+        )
         if sentinel_mask:
             keep = lambda new, old: jax.tree.map(  # noqa: E731
                 lambda n, o: jnp.where(finite, n, o), new, old

@@ -39,3 +39,20 @@ def load_shipped_config(*names, **kw):
     return load_config(
         *(os.path.join(CONFIGS_DIR, n + ".yaml") for n in names), **kw
     )
+
+
+def tree_equal(a, b) -> bool:
+    """Bitwise leaf-for-leaf equality of two pytrees — the assertion behind
+    every 'the masked/restored state is unchanged' claim (test_accum,
+    test_resilience). Structure compares first: a bare zip would let a
+    leaf-prefix tree (e.g. a restore that silently dropped an opt-state
+    subtree) pass as 'equal'."""
+    import jax
+
+    if (jax.tree_util.tree_structure(a) != jax.tree_util.tree_structure(b)):
+        return False
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b))
+    )

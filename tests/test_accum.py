@@ -1,0 +1,173 @@
+"""Gradient accumulation (training.accum_steps, training/step.py).
+
+The microbatched step's two documented numerics properties, pinned:
+
+* fp32 parity — equal-size micro-batches make mean-of-micro-means equal the
+  full-batch mean, so at fp32 the accumulated update matches the monolithic
+  step up to summation order (PARITY.md). Tested on a DUPLICATED batch
+  (every micro-batch identical) so the per-micro BN moments equal the
+  full-batch moments and parity is attainable at a tight tolerance; SGD,
+  not Adam, for the same reason as the mesh-equivalence tests (Adam's
+  first-step update is ~sign(grad)*lr, which amplifies fp-reassociation
+  noise on near-zero grads into full ±lr flips).
+
+* sequential BN policy — the batch-stats carry THREADS through the scan, so
+  k micro-batches update the running stats exactly as k separate steps
+  would. With identical micro-batches the k=2 result is the once-updated
+  stats re-updated with the same batch moments: s2 = (1+m)*s1 - m*s0, a
+  closed form that pins the policy without reaching into the model.
+
+Plus the sentinel composition: one poisoned micro-batch masks the WHOLE
+update bitwise (params/opt/BN unchanged, streams advance), exactly as a
+poisoned batch does at accum_steps=1 (tests/test_resilience.py).
+
+Both tests are slow-marked: between them they compile two full train
+steps (~3 min on the 2-core CPU host), which does not fit the tier-1 time
+budget — tier-1 coverage of the accumulation path is the bench_accum
+smoke (tests/test_tools_misc.py), which compiles the accum step and
+gates the peak-memory/FLOPs claims.
+"""
+
+import numpy as np
+import pytest
+
+
+from tests.conftest import tree_equal as _tree_equal
+
+
+@pytest.fixture(scope="module")
+def accum_setup():
+    """One compiled accum_steps=2 train step shared by both tests (the
+    compile dominates), plus the pieces to build comparison steps."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mine_tpu.config import Config
+    from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.training import build_model, init_state, make_train_step
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": 2,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2,
+        "mpi.fix_disparity": True,  # removes sampling noise from the
+        # per-micro rng fold (the fold exists for i.i.d. draws, which
+        # would defeat parity here)
+        "training.accum_steps": 2,
+        "resilience.sentinel_policy": "skip",
+    })
+    model = build_model(cfg)
+    tx = optax.sgd(0.1)
+    state0 = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    step_accum = jax.jit(make_train_step(cfg, model, tx))
+
+    one = make_synthetic_batch(1, 128, 128, n_points=16, seed=0)
+    one.pop("src_depth")
+    # duplicated batch: micro-batch 0 == micro-batch 1 == the full batch's
+    # per-example content, so full-batch BN moments == per-micro moments
+    dup = {
+        k: jnp.asarray(np.concatenate([v, v], axis=0)) for k, v in one.items()
+    }
+    return cfg, model, tx, state0, step_accum, dup
+
+
+@pytest.mark.slow
+def test_accum_parity_with_full_batch_step(accum_setup):
+    """accum_steps=2 over the duplicated batch == the monolithic step on
+    the same batch: equal loss/grad_norm, per-leaf updates tight, and the
+    BN carry follows the sequential closed form."""
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.training import make_train_step
+
+    cfg, model, tx, state0, step_accum, dup = accum_setup
+    cfg_full = cfg.replace(**{"training.accum_steps": 1})
+    step_full = jax.jit(make_train_step(cfg_full, model, tx))
+
+    new_f, loss_f = step_full(state0, dup)
+    new_a, loss_a = step_accum(state0, dup)
+
+    assert float(loss_a["loss"]) == pytest.approx(
+        float(loss_f["loss"]), rel=2e-4
+    )
+    # looser than the loss: the GLOBAL norm includes the zero-effective-
+    # gradient BN/conv-bias leaves whose values are pure fp-reassociation
+    # noise between one batch-2 conv and two batch-1 convs
+    assert float(loss_a["grad_norm"]) == pytest.approx(
+        float(loss_f["grad_norm"]), rel=1e-2
+    )
+    assert float(loss_a["update_skipped"]) == 0.0
+
+    upd_f = jax.tree.map(lambda n, o: n - o, new_f.params, state0.params)
+    upd_a = jax.tree.map(lambda n, o: n - o, new_a.params, state0.params)
+    # global scale for the near-zero filter: conv biases feeding straight
+    # into BN have exactly zero effective gradient, their "updates" are
+    # pure fp noise (same filter as tests/test_parallel.py)
+    gnorm = float(jnp.sqrt(sum(
+        jnp.sum(u.astype(jnp.float32) ** 2) for u in jax.tree.leaves(upd_f)
+    )))
+    for (path, uf), ua in zip(
+        jax.tree_util.tree_leaves_with_path(upd_f), jax.tree.leaves(upd_a)
+    ):
+        nf = float(jnp.linalg.norm(uf))
+        na = float(jnp.linalg.norm(ua))
+        if max(nf, na) < 1e-4 * gnorm:
+            continue
+        diff = float(jnp.linalg.norm(uf - ua))
+        assert diff <= 0.02 * max(nf, na), (
+            f"{jax.tree_util.keystr(path)}: |Δu|={diff:.4g} vs |u|={nf:.4g}"
+        )
+
+    # sequential BN policy, closed form for k=2 identical micro-batches:
+    # flax updates ra' = m*ra + (1-m)*batch_stat, applied twice with the
+    # same batch_stat => s2 = (1+m)*s1 - m*s0 where s1 is the once-updated
+    # (monolithic) stats. Momentum cancels out of the identity.
+    s0 = jax.tree.leaves(state0.batch_stats)
+    s1 = jax.tree.leaves(new_f.batch_stats)
+    s2 = jax.tree.leaves(new_a.batch_stats)
+    momentum = 0.9  # flax BatchNorm default, as built by build_model
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(s0, s1)
+    ), "batch stats did not move — BN policy untestable"
+    for a0, a1, a2 in zip(s0, s1, s2):
+        want = (1.0 + momentum) * np.asarray(a1) - momentum * np.asarray(a0)
+        np.testing.assert_allclose(
+            np.asarray(a2), want, rtol=5e-3, atol=1e-5
+        )
+
+
+@pytest.mark.slow
+def test_accum_sentinel_masks_whole_update_bitwise(accum_setup):
+    """One NaN-poisoned micro-batch (the SECOND of two) zeroes nothing and
+    averages nothing — the whole update is masked bitwise while step/rng
+    advance, identical to the k=1 sentinel contract."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg, model, tx, state0, step_accum, dup = accum_setup
+
+    state1, ld1 = step_accum(state0, dup)
+    assert float(ld1["update_skipped"]) == 0.0
+
+    poisoned = dict(dup)
+    # rows [1:] form the second micro-batch after the (k, b/k, ...) reshape
+    poisoned["src_img"] = poisoned["src_img"].at[1:].set(float("nan"))
+    state2, ld2 = step_accum(state1, poisoned)
+    assert float(ld2["update_skipped"]) == 1.0
+
+    host1, host2 = jax.device_get(state1), jax.device_get(state2)
+    assert _tree_equal(host2.params, host1.params)
+    assert _tree_equal(host2.opt_state, host1.opt_state)
+    assert _tree_equal(host2.batch_stats, host1.batch_stats)
+    assert int(host2.step) == int(host1.step) + 1
+
+    # the next clean step trains normally from the protected params
+    state3, ld3 = step_accum(state2, dup)
+    assert float(ld3["update_skipped"]) == 0.0
+    assert np.isfinite(float(ld3["loss"]))
+    assert not _tree_equal(jax.device_get(state3).params, host2.params)

@@ -689,3 +689,171 @@ def test_sharded_render_src_matches_unsharded(rng, use_alpha, is_bg_depth_inf):
         np.testing.assert_allclose(
             np.asarray(g_), np.asarray(w_), rtol=1e-4, atol=1e-5, err_msg=name
         )
+
+
+# ------------------------------------ ZeRO-1 optimizer-state sharding
+
+
+def test_zero1_partition_rule_is_pure_shape_function():
+    """The split decision depends only on the leaf SHAPE — so a param, its
+    grad, and its Adam moments (same shape by construction) always agree —
+    and prefers the largest dividing dimension."""
+    from mine_tpu.parallel import zero1
+
+    R = zero1.REPLICATED
+    # largest dim that divides n_shards wins, not the first
+    assert zero1.partition_dim((3, 3, 16, 2048), 8, 1024) == 3
+    assert zero1.partition_dim((2048, 16, 3, 3), 8, 1024) == 0
+    # small leaves, scalars, and non-dividing shapes replicate
+    assert zero1.partition_dim((64,), 8, 1024) == R
+    assert zero1.partition_dim((), 8, 1024) == R
+    assert zero1.partition_dim((6, 10, 30), 8, 1) == R
+    # a 1-wide axis never shards
+    assert zero1.partition_dim((2048,), 1, 1024) == R
+
+
+@pytest.fixture(scope="module")
+def zero1_state():
+    """Real model params + the production optimizer chain (the elementwise
+    chain zero1.py's exactness claim is about), shared by the bytes and
+    shard_update tests."""
+    from mine_tpu.config import Config
+    from mine_tpu.training import init_state, make_optimizer
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "model.num_layers": 18,
+        "model.dtype": "float32", "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2, "parallel.zero1": True,
+    })
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    return cfg, model, tx, state
+
+
+@pytest.mark.slow
+def test_zero1_per_device_opt_bytes_shrink(zero1_state):
+    """Acceptance: per-device opt-state bytes <= ~(1/8 + eps) of replicated
+    on the 8-device mesh (measured 0.1259x: 1/8 plus the replicated small
+    leaves under zero1_min_size). Slow only for the shared real-model
+    init; the tier-1 byte gate is the bench_accum smoke's zero1.ratio."""
+    from mine_tpu.parallel import zero1
+
+    cfg, _model, _tx, state = zero1_state
+    mesh = make_mesh(data_parallel=8)
+    dev = jax.devices()[0]
+    repl = zero1.per_device_bytes(replicate_state(state, mesh).opt_state, dev)
+    shard = zero1.per_device_bytes(
+        zero1.place_state(state, mesh, cfg.parallel.zero1_min_size).opt_state,
+        dev,
+    )
+    assert repl > 0
+    assert shard / repl <= 1 / 8 + 0.05, shard / repl
+    # params/BN stay fully replicated — only the optimizer state shrinks
+    placed = zero1.place_state(state, mesh, cfg.parallel.zero1_min_size)
+    assert zero1.per_device_bytes(placed.params, dev) == zero1.per_device_bytes(
+        replicate_state(state, mesh).params, dev
+    )
+
+
+@pytest.mark.slow
+def test_zero1_shard_update_matches_full_update(zero1_state):
+    """update(slice(g), shard_state, slice(p)) == slice(update(g, state, p))
+    for the production chain: the sharded optimizer step is EXACT, not
+    approximate (measured max |delta| ~2e-9 — fp epsilon on lr-scale
+    updates)."""
+    from jax.sharding import NamedSharding
+
+    from mine_tpu.parallel import zero1
+
+    cfg, _model, tx, state = zero1_state
+    mesh = make_mesh(data_parallel=8)
+    n = 8
+    min_size = cfg.parallel.zero1_min_size
+
+    keys = iter(jax.random.split(
+        jax.random.PRNGKey(1), len(jax.tree.leaves(state.params))
+    ))
+    grads = jax.tree.map(
+        lambda p: 0.01 * jax.random.normal(next(keys), p.shape, p.dtype),
+        state.params,
+    )
+    upd_ref, opt_ref = tx.update(grads, state.opt_state, state.params)
+
+    dims = zero1.tree_partition_dims(state.params, n, min_size)
+    opt_specs = zero1.opt_state_specs(state.opt_state, n, min_size)
+    repl = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
+    sharded = shard_map(
+        lambda g, o, p: zero1.shard_update(tx, g, o, p, dims),
+        mesh=mesh,
+        in_specs=(repl(grads), opt_specs, repl(state.params)),
+        out_specs=(repl(upd_ref), opt_specs),
+    )
+    opt_placed = jax.device_put(
+        state.opt_state,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs),
+    )
+    upd_sh, opt_sh = jax.jit(sharded)(grads, opt_placed, state.params)
+
+    for a, b in zip(jax.tree.leaves(upd_ref), jax.tree.leaves(upd_sh)):
+        np.testing.assert_allclose(
+            np.asarray(a), jax.device_get(b), rtol=1e-6, atol=1e-8
+        )
+    # the new LOCAL opt state gathers (device_get) back to the full one
+    for a, b in zip(jax.tree.leaves(opt_ref), jax.tree.leaves(opt_sh)):
+        np.testing.assert_allclose(
+            np.asarray(a), jax.device_get(b), rtol=1e-6, atol=1e-8
+        )
+
+
+@pytest.mark.slow
+def test_zero1_step_matches_replicated_mesh():
+    """Acceptance: the full train step under parallel.zero1 matches the
+    replicated layout on the 8-device mesh — with the PRODUCTION Adam
+    chain, far inside the existing mesh-equivalence tolerance: both runs
+    see bitwise-identical grads (same mesh, same shards), and the sharded
+    update is elementwise-exact (measured: loss delta 0.0, worst leaf
+    update rel diff 7e-7, gathered opt-state diff 0.0)."""
+    from mine_tpu.parallel import distribute_state
+    from mine_tpu.training import make_optimizer
+
+    base = {
+        "data.img_h": 128, "data.img_w": 128, "model.num_layers": 18,
+        "model.dtype": "float32", "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2, "mpi.fix_disparity": True,
+    }
+    batch_np = make_synthetic_batch(8, 128, 128, n_points=16, seed=0)
+    batch_np.pop("src_depth")
+    mesh = make_mesh(data_parallel=8)
+
+    results = {}
+    for name, zero1_on in (("repl", False), ("zero1", True)):
+        cfg = Config().replace(**dict(base, **{"parallel.zero1": zero1_on}))
+        model = build_model(cfg, axis_name=DATA_AXIS)
+        tx = make_optimizer(cfg, steps_per_epoch=100)
+        state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+        state = distribute_state(state, cfg, mesh)
+        step = make_parallel_train_step(cfg, model, tx, mesh, state=state)
+        params_before = jax.device_get(state.params)
+        new, loss = step(state, shard_batch(mesh, batch_np))
+        upd = jax.tree.map(
+            lambda n, o: jax.device_get(n) - o, new.params, params_before
+        )
+        # device_get GATHERS the sharded opt state back to full arrays —
+        # the same property gather-on-save checkpoints rely on
+        results[name] = (upd, float(loss["loss"]), jax.device_get(new.opt_state))
+
+    (u1, l1, o1), (u2, l2, o2) = results["repl"], results["zero1"]
+    assert l2 == pytest.approx(l1, rel=1e-6)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(u1), jax.tree.leaves(u2)
+    ):
+        ra, rb = float(np.linalg.norm(a)), float(np.linalg.norm(b))
+        diff = float(np.linalg.norm(a - b))
+        assert diff <= 1e-4 * max(ra, rb, 1e-30), (
+            f"{jax.tree_util.keystr(path)}: |Δu|={diff:.4g} vs |u|={ra:.4g}"
+        )
+    for a, b in zip(jax.tree.leaves(o1), jax.tree.leaves(o2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-9
+        )

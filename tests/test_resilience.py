@@ -21,6 +21,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+from tests.conftest import tree_equal as _tree_equal
+
 from mine_tpu.resilience import chaos
 from mine_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
 from mine_tpu.serving.batcher import (
@@ -643,15 +645,6 @@ def tiny_train_setup():
     return cfg, state0, step_fn, batch_at
 
 
-def _tree_equal(a, b) -> bool:
-    import jax
-
-    leaves_a = jax.tree_util.tree_leaves(a)
-    leaves_b = jax.tree_util.tree_leaves(b)
-    return all(
-        np.array_equal(np.asarray(x), np.asarray(y))
-        for x, y in zip(leaves_a, leaves_b)
-    )
 
 
 def test_sentinel_mask_drops_nonfinite_update_in_graph(tiny_train_setup):
@@ -863,3 +856,71 @@ def test_chaos_drill_training_half_smoke(tmp_path):
     assert t["sentinel_skip_logged"] and t["preempt_save_logged"]
     assert t["resume_logged"] and t["mid_epoch_skip_logged"]
     assert t["resumed_final_step"] == 5
+
+
+# ------------------------------- ZeRO-1 checkpoint layout round-trip (PR 5)
+
+
+@pytest.mark.slow
+def test_zero1_checkpoint_roundtrip_layout_independent(tiny_train_setup,
+                                                       tmp_path):
+    """Save under ZeRO-1, restore into BOTH layouts. Checkpoints are
+    layout-free by construction — jax.device_get of a sharded opt state
+    gathers full arrays (gather-on-save) — so a ZeRO-1 run's checkpoint
+    restores into a replicated run and vice versa, and the last_good
+    pointer + opt-layout sidecar coexist without interfering (the
+    rollback/mid-epoch-resume machinery never sees the layout)."""
+    import jax
+
+    from mine_tpu.parallel import make_mesh, replicate_state, zero1
+    from mine_tpu.training import checkpoint as ckpt
+
+    cfg, state0, step_fn, batch_at = tiny_train_setup
+    # one real step so the Adam moments are nonzero (zeros would gather
+    # to zeros and prove nothing)
+    state1, _ = step_fn(state0, batch_at(0))
+    host1 = jax.device_get(state1)
+
+    mesh = make_mesh(data_parallel=8)
+    min_size = cfg.parallel.zero1_min_size
+    placed = zero1.place_state(host1, mesh, min_size)
+    # at least one moment leaf actually sharded (not a vacuous test)
+    assert any(
+        len(getattr(leaf, "addressable_shards", [])) > 1
+        and leaf.addressable_shards[0].data.shape != leaf.shape
+        for leaf in jax.tree_util.tree_leaves(placed.opt_state)
+    )
+
+    # gather-on-save: device_get of the SHARDED state == the host state
+    gathered = jax.device_get(placed)
+    assert _tree_equal(gathered.opt_state, host1.opt_state)
+    assert _tree_equal(gathered.params, host1.params)
+
+    ws = str(tmp_path / "ws")
+    manager = ckpt.checkpoint_manager(ws)
+    ckpt.save(manager, gathered, int(gathered.step))
+    ckpt.wait_until_finished(manager)
+    ckpt.mark_last_good(ws, int(gathered.step))
+    ckpt.record_opt_layout(ws, {
+        "zero1": True, "data_parallel": 8, "zero1_min_size": min_size,
+    })
+
+    # the two sidecars coexist: pointer still reads, layout round-trips
+    assert ckpt.last_good_step(ws) == int(gathered.step)
+    layout = ckpt.opt_layout(ws)
+    assert layout["zero1"] is True and layout["data_parallel"] == 8
+    assert layout["gathered_on_save"] is True
+
+    # restore into BOTH layouts; each gathers back to the same host state
+    template = jax.device_get(state0)
+    restored, step = ckpt.restore(ckpt.checkpoint_manager(ws), template)
+    assert step == int(gathered.step)
+    as_repl = jax.device_get(replicate_state(restored, mesh))
+    as_zero1 = jax.device_get(zero1.place_state(restored, mesh, min_size))
+    for got in (as_repl, as_zero1):
+        assert _tree_equal(got.opt_state, host1.opt_state)
+        assert _tree_equal(got.params, host1.params)
+        assert _tree_equal(got.batch_stats, host1.batch_stats)
+
+    # pre-zero1 workspaces have no layout sidecar: None, not an error
+    assert ckpt.opt_layout(str(tmp_path / "empty")) is None

@@ -1,0 +1,314 @@
+#!/usr/bin/env python3
+"""Gradient accumulation + ZeRO-1: the two memory claims of the
+microbatched update path, measured.
+
+Claim 1 (training.accum_steps, training/step.py): at EQUAL effective batch,
+the accumulating step's peak HBM tracks ONE micro-batch, not the full
+batch — the lax.scan serializes the k micro forward+backwards and only the
+fp32 grad accumulator + BN stats survive between iterations. For each k in
+--accum this compiles the real train step at per-device batch --b with
+accum_steps=k and reports
+
+  * peak_bytes   the executable's own accounting (memory_analysis:
+                 temp + output buffers — deterministic, exact, works on
+                 the CPU backend), state donated as in production;
+  * flops_raw    XLA cost analysis of the executable. cost_analysis counts
+                 a scan body ONCE (trip count is opaque to it), so at
+                 k > 1 this is ~one MICRO-step, ~flat in k at equal
+                 effective batch when normalized by k;
+  * update_flops flops_raw * k — the per-UPDATE figure the mine_train_*
+                 gauges publish (training/loop.py _per_update_cost). The
+                 anti-double-count cross-check: update_flops should be
+                 ~equal across k at equal effective batch;
+  * step_ms      measured wall time per update (--steps > 0).
+
+plus a `micro_ref` point: the plain step at batch b/k_max — the
+single-micro-batch floor the acceptance bound compares against. `value` is
+peak_bytes(accum=min)/peak_bytes(accum=max) at equal batch (>1 means
+accumulation peaks lower than the monolithic step).
+
+Claim 2 (parallel.zero1, parallel/zero1.py): Adam moments sharded over the
+data axis put ~1/n of the opt-state bytes on each device. With more than
+one device visible (the CPU fallback forces a virtual 8-device host) the
+bench places the SAME TrainState replicated and ZeRO-1 and reports
+per-device opt-state bytes for both plus their ratio (~1/n + the
+replicated-small-leaves epsilon).
+
+Backend policy (bench.py / bench_composite.py contract): TPU probed in a
+killable subprocess; unreachable/hung => labeled CPU measurement, never
+`value: null`. Prints exactly one JSON line. The tier-1 smoke runs
+`--steps 0 --no-micro-ref` (two compiles, run concurrently — affordable
+inside the suite's time budget) and asserts the accum=1-vs-4 peak delta,
+the FLOPs bookkeeping, and the ZeRO-1 byte ratio; the slow-marked full
+run adds the single-micro-batch floor bound (tests/test_tools_misc.py).
+
+  python tools/bench_accum.py                      # b=4, accum 1,4
+  python tools/bench_accum.py --b 8 --accum 1,2,8 --hw 128x256 --steps 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+METRIC = "train_step_accum_full_over_micro_peak_bytes"
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_ACCUM_PROBE_TIMEOUT_S", "120"))
+RUN_TIMEOUT_S = int(os.environ.get("BENCH_ACCUM_RUN_TIMEOUT_S", "1500"))
+
+
+def _emit_failure(exc: BaseException) -> None:
+    print(json.dumps({
+        "metric": METRIC,
+        "value": None,
+        "unit": "x",
+        "vs_baseline": None,
+        "error": f"{type(exc).__name__}: {exc}"[:2000],
+        "note": "accum bench failed before producing a measurement",
+    }))
+
+
+def _arm_watchdog(secs: int):
+    from mine_tpu.utils.platform import arm_watchdog
+
+    return arm_watchdog(secs, _emit_failure)
+
+
+def _peak_bytes(compiled) -> int | None:
+    """Peak live bytes of the executable itself: temp (scratch) + output
+    buffers from XLA's memory_analysis — same extraction as
+    bench_composite.py, donation-aware because the aliased state buffers
+    drop out of both the accum and the plain point identically."""
+    try:
+        ma = compiled.memory_analysis()
+        return int(ma.temp_size_in_bytes + ma.output_size_in_bytes)
+    except Exception:  # pragma: no cover - backend-dependent surface
+        return None
+
+
+def _build_shared(args):
+    """Model, optimizer, and TrainState — all batch-size- and accum-
+    independent, so ONE init serves every measured point (init_state is
+    ~1/3 of a point's cost on the CPU fallback)."""
+    import jax
+
+    from mine_tpu.config import Config
+    from mine_tpu.training import build_model, init_state, make_optimizer
+
+    cfg = Config().replace(**{
+        "data.name": "llff",
+        "data.img_h": args.h, "data.img_w": args.w,
+        "data.per_gpu_batch_size": args.b,
+        "model.num_layers": args.layers,
+        "model.dtype": "float32",
+        "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": args.planes,
+    })
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    return cfg, model, tx, state
+
+
+def _compile_point(args, shared, batch_size: int, accum: int):
+    """Compile the train step for one (batch, accum) point and read its
+    executable-level costs. Thread-safe: points compile CONCURRENTLY
+    (jit compilation drops the GIL), which is what makes the tier-1 smoke
+    affordable on a 2-core host."""
+    import jax
+    import jax.numpy as jnp
+
+    from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.obs.cost import compiled_cost
+    from mine_tpu.training import make_train_step
+
+    cfg0, model, tx, state = shared
+    cfg = cfg0.replace(**{
+        "data.per_gpu_batch_size": batch_size,
+        "training.accum_steps": accum,
+    })
+    batch_np = make_synthetic_batch(batch_size, args.h, args.w,
+                                    n_points=64, seed=0)
+    batch_np.pop("src_depth")
+    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+    step = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
+    compiled = step.lower(state, batch).compile()
+    raw = compiled_cost(compiled).flops
+    out = {
+        "batch": batch_size,
+        "accum": accum,
+        "effective_batch": batch_size,  # accumulation SPLITS, never grows it
+        "peak_bytes": _peak_bytes(compiled),
+        "flops_raw": raw,
+        "update_flops": raw * accum if raw else None,
+    }
+    return out, compiled, state, batch
+
+
+def _time_point(out: dict, compiled, state, batch, steps: int) -> dict:
+    """Timed updates, run SERIALLY after every point has compiled (wall
+    times under concurrent compilation would be garbage)."""
+    import jax
+
+    st, ld = compiled(state, batch)
+    jax.block_until_ready(ld["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st, ld = compiled(st, batch)
+    jax.block_until_ready(ld["loss"])
+    out["step_ms"] = round((time.perf_counter() - t0) / steps * 1e3, 2)
+    return out
+
+
+def _zero1_bytes(shared) -> dict | None:
+    """Per-device opt-state bytes, replicated vs ZeRO-1, on whatever mesh
+    the backend offers (>=2 devices; the CPU fallback forced 8 virtual
+    ones). Placement only — the numerics equivalence lives in
+    tests/test_parallel.py, the bytes claim is what a bench can add."""
+    import jax
+
+    from mine_tpu.parallel import make_mesh, replicate_state, zero1
+
+    n = len(jax.devices())
+    if n < 2:
+        return None
+    cfg, _model, _tx, state = shared
+    mesh = make_mesh(data_parallel=n)
+    dev = jax.devices()[0]
+    repl = zero1.per_device_bytes(replicate_state(state, mesh).opt_state, dev)
+    shard = zero1.per_device_bytes(
+        zero1.place_state(state, mesh, cfg.parallel.zero1_min_size).opt_state,
+        dev,
+    )
+    return {
+        "devices": n,
+        "opt_bytes_replicated_per_device": repl,
+        "opt_bytes_zero1_per_device": shard,
+        "ratio": round(shard / repl, 4) if repl else None,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--b", type=int, default=4,
+                    help="per-device batch (the EFFECTIVE batch of every "
+                         "accum point)")
+    ap.add_argument("--accum", default="1,4",
+                    help="comma-separated accum_steps values; each must "
+                         "divide --b")
+    ap.add_argument("--hw", default="128x128",
+                    help="HxW (multiples of 128 — decoder constraint)")
+    ap.add_argument("--planes", type=int, default=2, help="MPI plane count")
+    ap.add_argument("--layers", type=int, default=18, help="ResNet depth")
+    ap.add_argument("--steps", type=int, default=2,
+                    help="timed updates per point; 0 = compile/memory only")
+    ap.add_argument("--micro-ref", dest="micro_ref", action="store_true",
+                    default=True,
+                    help="also measure the plain step at batch b/max(accum) "
+                         "— the single-micro-batch floor peak(accum=k) "
+                         "should track (default on)")
+    ap.add_argument("--no-micro-ref", dest="micro_ref", action="store_false",
+                    help="skip the floor point (one fewer compile: what the "
+                         "tier-1 smoke runs; the floor bound is asserted by "
+                         "the slow-marked full run)")
+    args = ap.parse_args()
+    args.h, args.w = (int(v) for v in args.hw.lower().split("x"))
+    accums = sorted({int(v) for v in args.accum.split(",") if v})
+    for k in accums:
+        if args.b % k:
+            ap.error(f"--accum {k} does not divide --b {args.b}")
+
+    from mine_tpu.utils.platform import resolve_backend_probe
+
+    backend_note = resolve_backend_probe(PROBE_TIMEOUT_S)
+    if backend_note.startswith("cpu"):
+        # the ZeRO-1 half needs something to shard over: 8 virtual host
+        # devices, same recipe as tests/conftest.py (must precede any
+        # backend touch)
+        from mine_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(8, fast_compile=args.steps == 0)
+    run_ok = _arm_watchdog(RUN_TIMEOUT_S)
+
+    import concurrent.futures
+
+    import jax
+
+    shared = _build_shared(args)
+    specs = [(args.b, k) for k in accums]
+    if args.micro_ref:
+        specs.append((args.b // accums[-1], 1))
+    # concurrent compiles: jit compilation drops the GIL, so the points
+    # overlap on a multi-core host (order preserved by ex.map)
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(4, len(specs))
+    ) as ex:
+        measured = list(ex.map(
+            lambda s: _compile_point(args, shared, *s), specs
+        ))
+    if args.steps > 0:
+        # serially, AFTER all compiles; each point gets a fresh copy of the
+        # state (the compiled steps donate argument 0)
+        host_state = jax.device_get(shared[3])
+        for out, compiled, _state, batch in measured:
+            _time_point(out, compiled, jax.device_put(host_state), batch,
+                        args.steps)
+    points = [m[0] for m in measured[:len(accums)]]
+    micro_ref = None
+    if args.micro_ref:
+        micro_ref = measured[-1][0]
+        micro_ref["role"] = "micro_ref"
+    zero1_stats = _zero1_bytes(shared)
+
+    peak_lo = points[0]["peak_bytes"]
+    peak_hi = points[-1]["peak_bytes"]
+    ratio = round(peak_lo / peak_hi, 3) if peak_lo and peak_hi else None
+
+    run_ok.set()
+    print(json.dumps({
+        "metric": METRIC,
+        "value": ratio,
+        "unit": "x",
+        "vs_baseline": None,
+        "b": args.b, "h": args.h, "w": args.w,
+        "planes": args.planes, "layers": args.layers,
+        "accum": accums,
+        "points": points,
+        "micro_ref": micro_ref,
+        "zero1": zero1_stats,
+        "device": jax.devices()[0].device_kind,
+        "backend": backend_note,
+        "note": (
+            "value = peak live bytes (XLA memory_analysis: temp+output) of "
+            "the monolithic step over the accum_steps=max step at the SAME "
+            "effective batch; >1 means accumulation peaks lower. micro_ref "
+            "(absent under --no-micro-ref) is the single-micro-batch step "
+            "the acceptance bound compares against (peak(accum=k) ~ "
+            "peak(micro)+fp32 accumulator). "
+            "flops_raw is XLA's executable analysis, which counts the "
+            "accumulation scan body ONCE; update_flops = flops_raw*k is "
+            "the per-UPDATE figure and should be ~equal across k at equal "
+            "effective batch. zero1.ratio ~ 1/devices + "
+            "replicated-small-leaves epsilon"
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 - emit-then-reraise contract
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _emit_failure(exc)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
