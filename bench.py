@@ -357,6 +357,57 @@ def _measure_point(
     }
 
 
+def _ledger_update(result: dict, workload: dict) -> None:
+    """Append this measurement to the perf ledger (obs/ledger.py) and embed
+    the row in the emitted JSON's obs snapshot. With >= 2 prior comparable
+    rows (same metric/workload digest/device/backend class), vs_baseline
+    switches from the static null to the ledger's rolling median — a
+    denominator that tracks THIS hardware instead of awaiting a reference
+    number that will never exist. Never raises: the measurement outranks
+    the ledger."""
+    from mine_tpu.obs import ledger
+
+    try:
+        peak_hbm = None
+        try:
+            from mine_tpu.obs.memlog import device_memory_stats
+
+            for entry in device_memory_stats():
+                stats = entry.get("stats") or {}
+                p = stats.get("peak_bytes_in_use")
+                if p is not None:
+                    peak_hbm = max(peak_hbm or 0, int(p))
+        except Exception:  # noqa: BLE001 - CPU backends have no stats
+            pass
+        if peak_hbm is not None:
+            result["peak_hbm_bytes"] = peak_hbm
+        row = ledger.append_bench_row({
+            "metric": result["metric"], "value": result["value"],
+            "unit": result["unit"], "higher_is_better": True,
+            "mfu": result.get("mfu"), "step_ms": result.get("step_ms"),
+            "peak_hbm_bytes": peak_hbm, "device": result.get("device"),
+            "backend": result.get("backend"),
+        }, workload)
+        if row is None:
+            return  # ledger disabled via $MINE_TPU_PERF_LEDGER
+        result["obs"]["ledger_row"] = row
+        rows, _ = ledger.read(ledger.ledger_path())
+        key = ledger.stream_key(row)
+        prior = [r for r in rows if ledger.stream_key(r) == key][:-1]
+        usable = [r for r in prior
+                  if isinstance(r.get("value"), (int, float))]
+        if len(usable) >= 2 and isinstance(result["value"], (int, float)):
+            base = ledger.rolling_baseline(usable)
+            if base:
+                result["vs_baseline"] = round(result["value"] / base, 4)
+                result["vs_baseline_source"] = (
+                    "perf_ledger rolling median of the last "
+                    f"{min(len(usable), 5)} comparable rows"
+                )
+    except Exception as exc:  # noqa: BLE001 - instrument, never the number
+        print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+
+
 def _run(backend_note: str = "", on_cpu: bool = False) -> None:
     global _RESULT_SO_FAR
     profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
@@ -413,6 +464,13 @@ def _run(backend_note: str = "", on_cpu: bool = False) -> None:
         except Exception as e:  # noqa: BLE001 - the primary number stands alone
             print(f"# B=8 point failed: {e}", file=sys.stderr)
             result["b8_error"] = f"{type(e).__name__}: {e}"[:500]
+
+    with _TRACER.span("ledger", cat="bench"):
+        _ledger_update(result, workload={
+            "h": 384, "w": 512, "planes": 32, "batch": BATCH,
+            "width_multiple": primary["width_multiple"],
+            "recipe": "llff_4scale_adam",
+        })
 
     print(json.dumps(result))
 

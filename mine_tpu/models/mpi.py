@@ -43,17 +43,22 @@ class MPINetwork(nn.Module):
 
     @nn.compact
     def __call__(self, src_imgs: Array, disparity: Array, train: bool = True):
-        feats = ResNetEncoder(
-            num_layers=self.num_layers, axis_name=self.axis_name,
-            dtype=self.dtype, name="backbone",
-        )(src_imgs, train)
-        return MPIDecoder(
-            multires=self.multires, use_alpha=self.use_alpha,
-            scales=self.scales, sigma_dropout_rate=self.sigma_dropout_rate,
-            axis_name=self.axis_name, plane_axis=self.plane_axis,
-            dtype=self.dtype, width_multiple=self.decoder_width_multiple,
-            name="decoder",
-        )(feats, disparity, train)
+        # component scopes (obs/attrib.py): every XLA op's metadata carries
+        # the owning component, so profiler traces attribute device time to
+        # encoder vs decoder — pure metadata, a numerics no-op (PARITY.md)
+        with jax.named_scope("encoder"):
+            feats = ResNetEncoder(
+                num_layers=self.num_layers, axis_name=self.axis_name,
+                dtype=self.dtype, name="backbone",
+            )(src_imgs, train)
+        with jax.named_scope("decoder"):
+            return MPIDecoder(
+                multires=self.multires, use_alpha=self.use_alpha,
+                scales=self.scales, sigma_dropout_rate=self.sigma_dropout_rate,
+                axis_name=self.axis_name, plane_axis=self.plane_axis,
+                dtype=self.dtype, width_multiple=self.decoder_width_multiple,
+                name="decoder",
+            )(feats, disparity, train)
 
 
 def predict_mpi_coarse_to_fine(

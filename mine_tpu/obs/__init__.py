@@ -1,6 +1,6 @@
-"""Observability: host-span tracing, flight recorder, cost/MFU accounting.
+"""Observability: tracing, flight recorder, cost/MFU, attribution, ledger.
 
-Three pillars (no reference analog — the reference logs loss lines and
+Six pillars (no reference analog — the reference logs loss lines and
 nothing else; VERDICT r5 records five consecutive benchmark rounds that
 died with zero diagnostics):
 
@@ -11,11 +11,28 @@ died with zero diagnostics):
   * obs/cost.py   — per-compiled-step FLOPs/bytes from XLA's own cost
     analysis, a per-platform peak table, and MFU / achieved-bandwidth
     arithmetic.
+  * obs/attrib.py — per-component device-time attribution: jax.named_scope
+    annotations (encoder/decoder/warp/composite/losses/optimizer/
+    zero1_gather) joined with profiler traces or compiled HLO metadata
+    into a table that must account for >= 90% of device time.
+  * obs/memlog.py — live HBM telemetry: device.memory_stats() polled into
+    hbm_{live,peak}_bytes gauges + Chrome-trace counter events.
+  * obs/ledger.py — append-only JSONL perf ledger with a rolling-baseline
+    regression gate (tools/perf_ledger.py check).
 
-Everything is stdlib + jax-optional: the tracer and flight recorder never
-import jax at module level, so they work in data-loader processes too.
+Everything is stdlib + jax-optional: the tracer, flight recorder, ledger
+and attribution parser never import jax at module level, so they work in
+data-loader processes and offline tools too.
 """
 
+from mine_tpu.obs.attrib import (
+    COMPONENTS,
+    UNATTRIBUTED,
+    attribute_events,
+    attribute_profile_dir,
+    component_of,
+    hlo_op_components,
+)
 from mine_tpu.obs.cost import (
     StepCost,
     achieved_fraction,
@@ -25,17 +42,25 @@ from mine_tpu.obs.cost import (
     compute_mfu,
 )
 from mine_tpu.obs.flight import FlightRecorder
+from mine_tpu.obs.memlog import MemLog
 from mine_tpu.obs.trace import NULL_TRACER, Span, Tracer
 
 __all__ = [
+    "COMPONENTS",
     "FlightRecorder",
+    "MemLog",
     "NULL_TRACER",
     "Span",
     "StepCost",
     "Tracer",
+    "UNATTRIBUTED",
     "achieved_fraction",
+    "attribute_events",
+    "attribute_profile_dir",
     "chip_peak_flops",
     "chip_peak_hbm_bytes",
     "compiled_cost",
+    "component_of",
     "compute_mfu",
+    "hlo_op_components",
 ]

@@ -1,0 +1,384 @@
+"""Per-component device-time attribution: who owns the step time.
+
+The MFU-climb roadmap item stalls on a question tools/profile_summary.py
+cannot answer: raw HLO op rows ("fusion.123", "dot.4") say nothing about
+WHICH model component — encoder, decoder, warp, composite, losses,
+optimizer — owns the device time. The components are now annotated with
+`jax.named_scope` throughout models/, ops/, training/step.py and
+parallel/zero1.py, so every XLA op's metadata carries a scope path like
+
+    jit(train_step)/transpose(jvp(...))/losses/composite/reduce_sum
+
+This module turns that metadata plus a captured profile into a
+per-component table:
+
+  * `hlo_op_components(hlo_text)` parses the compiled executable's own
+    text (`compiled.as_text()`) into {instruction name -> component}: the
+    op_name metadata survives fusion, so "fusion.123" still knows which
+    scope it came from.
+  * `attribute_events(events, op_components)` walks Chrome-trace events
+    (jax.profiler device traces: TPU TensorCore lanes carry the scope in
+    their event args; CPU runs carry only the HLO instruction name, which
+    the HLO map resolves) and buckets durations per component, with an
+    explicit `unattributed` remainder row and a coverage fraction — the
+    table is only trustworthy when coverage >= COVERAGE_TARGET (0.9).
+  * `attribute_profile_dir(dir)` glues both halves for a run directory:
+    newest device trace + the `*_hlo.txt` dump training writes next to it.
+  * `attribute_hlo(hlo_text, cost)` is the trace-free fallback
+    (cost_analysis totals + per-component HLO op counts): CPU runs that
+    never captured a profile still get an honest op-count breakdown, with
+    time columns absent rather than fabricated.
+
+Everything is stdlib-only at import time (no jax), so the offline
+tools/profile_summary.py reader can use it too.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Iterable
+
+# Components in the order the scopes nest, innermost-distinctive first.
+# component_of scans an op_name's path segments RIGHT-TO-LEFT, so an op
+# inside jit(...)/losses/composite/... attributes to "composite", not
+# "losses" — the innermost annotated scope wins, which is what makes the
+# nesting (losses wraps the render calls) attribute correctly.
+COMPONENT_PATTERNS: tuple[tuple[str, re.Pattern], ...] = tuple(
+    (name, re.compile(pat))
+    for name, pat in (
+        ("zero1_gather", r"^zero1_gather$"),
+        ("optimizer", r"^optimizer$"),
+        ("losses", r"^losses$"),
+        ("homography_warp", r"^homography_warp$"),
+        ("composite", r"^composite$"),
+        ("decoder", r"^decoder$"),
+        # flax names the encoder module "backbone"; both spellings map
+        ("encoder", r"^(encoder|backbone)$"),
+    )
+)
+
+COMPONENTS = tuple(name for name, _ in COMPONENT_PATTERNS)
+UNATTRIBUTED = "unattributed"
+
+# the table "accounts for" the step only above this attributed fraction
+# (the acceptance bar every consumer quotes)
+COVERAGE_TARGET = 0.9
+
+
+def component_of(op_name: str | None) -> str | None:
+    """Map one op_name metadata path to its component (None = unscoped).
+
+    Scans the '/'-separated path segments innermost-first; transform
+    wrappers like "transpose(jvp(main))" around a segment are stripped so
+    backward-pass ops attribute to the same component as their forward.
+    """
+    if not op_name:
+        return None
+    for seg in reversed(op_name.split("/")):
+        # peel transform wrappers: transpose(jvp(encoder)) -> encoder
+        while True:
+            m = re.fullmatch(r"[\w.\-]+\((.*)\)", seg)
+            if m is None:
+                break
+            seg = m.group(1)
+        for name, pat in COMPONENT_PATTERNS:
+            if pat.search(seg):
+                return name
+    return None
+
+
+# HLO text: `%instr.name = type op(...), ..., metadata={... op_name="..."}`
+_HLO_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=")
+_HLO_OPNAME_RE = re.compile(r'metadata=\{[^}]*?op_name="([^"]*)"')
+# computation references an instruction makes: calls=%f / to_apply=%f /
+# {body,condition}=%f (while) / branch_computations={%a, %b} (conditional)
+_HLO_CALLED_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|branch_computations=\{[^}]*?)"
+    r"=?%([\w.\-]+)"
+)
+# a computation header: `%name (params) -> type {` — no `=` before the body
+_HLO_COMP_DEF_RE = re.compile(r"^\s*(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
+_HLO_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _parse_hlo(hlo_text: str) -> list[dict]:
+    """One record per HLO instruction across every computation in the
+    module: name, direct component (op_name metadata), called computation
+    names, operand instruction names, and the home computation."""
+    records: list[dict] = []
+    comp_name = None
+    for line in hlo_text.splitlines():
+        m = _HLO_INSTR_RE.match(line)
+        if m is None:
+            cm = _HLO_COMP_DEF_RE.match(line)
+            if cm is not None and "=" not in line.split("(")[0]:
+                comp_name = cm.group(1)
+            continue
+        om = _HLO_OPNAME_RE.search(line)
+        rhs = line.split("=", 1)[1]
+        called = set(_HLO_CALLED_RE.findall(line))
+        refs = [r for r in _HLO_REF_RE.findall(rhs)
+                if r not in called and r != m.group(1)]
+        records.append({
+            "name": m.group(1),
+            "component": component_of(om.group(1)) if om else None,
+            "calls": called,
+            "operands": refs,
+            "computation": comp_name,
+        })
+    return records
+
+
+def hlo_op_components(hlo_text: str) -> dict[str, str]:
+    """{HLO instruction name -> component} from a compiled module's text.
+
+    Covers every computation in the module (fused computations included:
+    CPU thunk events carry inner instruction names like "tanh.5.clone").
+    Three resolution rules, run to fixpoint:
+
+      1. direct — the instruction's own op_name metadata names a scope;
+      2. called-computation majority — XLA wraps scoped regions in
+         metadata-less `call`/`fusion` instructions whose CALLED
+         computation's ops still carry the scope;
+      3. operand inheritance — a metadata-less op (e.g. the reduce-window
+         XLA:CPU inserts for a big reduce) belongs to the scope of the
+         values it consumes, when those agree.
+
+    Instructions no rule reaches are omitted — absence means
+    `unattributed`, never a guess.
+    """
+    records = _parse_hlo(hlo_text)
+    resolved: dict[str, str] = {
+        r["name"]: r["component"] for r in records
+        if r["component"] is not None
+    }
+    for _ in range(8):  # fixpoint: chains are shallow in practice
+        # one O(N) sweep per pass: majority component of every computation
+        votes: dict[str, dict[str, int]] = {}
+        for r in records:
+            c = resolved.get(r["name"])
+            if c is not None:
+                v = votes.setdefault(r["computation"], {})
+                v[c] = v.get(c, 0) + 1
+        majority = {
+            comp: max(v.items(), key=lambda kv: kv[1])[0]
+            for comp, v in votes.items()
+        }
+        changed = False
+        for r in records:
+            if r["name"] in resolved:
+                continue
+            comp = None
+            # rule 2: the called computation's majority component
+            for callee in r["calls"]:
+                comp = majority.get(callee)
+                if comp is not None:
+                    break
+            if comp is None and r["operands"]:
+                # rule 3: unanimous resolved operands
+                ops = {resolved[o] for o in r["operands"] if o in resolved}
+                if len(ops) == 1:
+                    comp = ops.pop()
+            if comp is not None:
+                resolved[r["name"]] = comp
+                changed = True
+        if not changed:
+            break
+    return resolved
+
+
+def _event_op_name(ev: dict) -> str | None:
+    """The scope-carrying metadata a device trace event itself provides
+    (TPU TensorCore lanes); None when only an HLO instruction name exists
+    (CPU runs — resolved via the HLO map instead)."""
+    args = ev.get("args") or {}
+    for key in ("tf_op", "long_name", "op_name"):
+        v = args.get(key)
+        if isinstance(v, str) and "/" in v:
+            return v
+    return None
+
+
+def _is_op_event(ev: dict, device_pids: set | None) -> bool:
+    if ev.get("ph") != "X":
+        return False
+    if device_pids:
+        return ev.get("pid") in device_pids
+    # no device lane metadata (CPU runs): XLA op executions are exactly
+    # the events annotated with their HLO op
+    return "hlo_op" in (ev.get("args") or {})
+
+
+def attribute_events(
+    events: Iterable[dict],
+    op_components: dict[str, str] | None = None,
+    device_pids: set | None = None,
+) -> dict:
+    """Bucket device-trace op events into per-component time.
+
+    Returns {"rows": [{component, time_ms, pct, calls}...] sorted by time
+    (the `unattributed` remainder always last), "total_ms", "attributed_ms",
+    "coverage", "covered": coverage >= COVERAGE_TARGET}.
+    """
+    op_components = op_components or {}
+    totals: dict[str, list[float]] = {}
+    total_us = 0.0
+    for ev in events:
+        if not _is_op_event(ev, device_pids):
+            continue
+        dur = float(ev.get("dur", 0.0))
+        total_us += dur
+        comp = component_of(_event_op_name(ev))
+        if comp is None:
+            args = ev.get("args") or {}
+            op = args.get("hlo_op") or ev.get("name", "")
+            comp = op_components.get(str(op))
+        if comp is None:
+            comp = UNATTRIBUTED
+        tot = totals.setdefault(comp, [0.0, 0])
+        tot[0] += dur
+        tot[1] += 1
+    attributed_us = sum(
+        t[0] for comp, t in totals.items() if comp != UNATTRIBUTED
+    )
+    rows = [
+        {
+            "component": comp,
+            "time_ms": round(t[0] / 1e3, 3),
+            "pct": round(100.0 * t[0] / total_us, 1) if total_us else None,
+            "calls": int(t[1]),
+        }
+        for comp, t in totals.items()
+    ]
+    rows.sort(key=lambda r: (r["component"] == UNATTRIBUTED, -r["time_ms"]))
+    coverage = (attributed_us / total_us) if total_us else 0.0
+    return {
+        "rows": rows,
+        "total_ms": round(total_us / 1e3, 3),
+        "attributed_ms": round(attributed_us / 1e3, 3),
+        "coverage": round(coverage, 4),
+        "covered": coverage >= COVERAGE_TARGET,
+    }
+
+
+def attach_cost_estimates(table: dict, flops: float | None,
+                          bytes_accessed: float | None) -> dict:
+    """Add time-weighted FLOPs/bytes estimates to an attribution table:
+    the executable's cost_analysis totals split by each component's share
+    of attributed time. An estimate (XLA only totals whole executables),
+    labeled as such — the time column is the measurement."""
+    if not table.get("rows"):
+        return table
+    for row in table["rows"]:
+        share = (row["time_ms"] / table["total_ms"]) if table["total_ms"] else 0.0
+        row["flops_est"] = round(flops * share) if flops else None
+        row["bytes_est"] = round(bytes_accessed * share) if bytes_accessed else None
+    table["cost_note"] = (
+        "flops_est/bytes_est are the executable's cost_analysis totals "
+        "split by time share — estimates, not per-op counts"
+    )
+    return table
+
+
+def attribute_hlo(hlo_text: str, flops: float | None = None,
+                  bytes_accessed: float | None = None) -> dict:
+    """Trace-free fallback: per-component HLO op COUNTS (no fabricated
+    time column) plus the executable's aggregate cost_analysis figures.
+    Coverage here is op-count coverage — which fraction of metadata-scoped
+    instructions landed under a named component."""
+    resolved = hlo_op_components(hlo_text)
+    counts: dict[str, int] = {}
+    total = 0
+    for rec in _parse_hlo(hlo_text):
+        total += 1
+        comp = resolved.get(rec["name"], UNATTRIBUTED)
+        counts[comp] = counts.get(comp, 0) + 1
+    attributed = sum(n for c, n in counts.items() if c != UNATTRIBUTED)
+    rows = [
+        {"component": c, "hlo_ops": n,
+         "pct": round(100.0 * n / total, 1) if total else None}
+        for c, n in counts.items()
+    ]
+    rows.sort(key=lambda r: (r["component"] == UNATTRIBUTED, -r["hlo_ops"]))
+    return {
+        "rows": rows,
+        "hlo_ops_total": total,
+        "coverage": round(attributed / total, 4) if total else 0.0,
+        "flops_total": flops,
+        "bytes_total": bytes_accessed,
+        "basis": "hlo_op_count",
+        "note": "no device trace: shares are HLO op counts, not time — "
+                "capture a profile (obs.profile_steps) for time attribution",
+    }
+
+
+# -- run-directory glue -------------------------------------------------------
+
+
+def find_trace_files(root: str) -> list[str]:
+    out: list[str] = []
+    for pat in ("*.trace.json.gz", "*.trace.json"):
+        out.extend(glob.glob(os.path.join(root, "**", pat), recursive=True))
+    return sorted(out)
+
+
+def load_trace_events(path: str) -> list[dict]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        data = json.load(fh)
+    return data.get("traceEvents", data if isinstance(data, list) else [])
+
+
+def find_hlo_text(root: str) -> str | None:
+    """Newest `*_hlo.txt` dump under the run dir (training writes
+    `train_step_hlo.txt` next to its profile when obs is enabled)."""
+    paths = sorted(glob.glob(os.path.join(root, "**", "*_hlo.txt"),
+                             recursive=True))
+    if not paths:
+        return None
+    with open(paths[-1]) as fh:
+        return fh.read()
+
+
+def _device_lane_pids(events: list[dict]) -> set:
+    """pids of on-device lanes (TensorCore etc.) — mirrors
+    tools/profile_summary.py's device_pids, minus the host-span lane."""
+    pids = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = ev.get("args", {}).get("name", "")
+            if "mine_tpu host" in name:
+                continue
+            if any(k in name.lower() for k in
+                   ("tensorcore", "sparsecore", "/device:")):
+                pids.add(ev["pid"])
+    return pids
+
+
+def attribute_profile_dir(
+    trace_dir: str, hlo_text: str | None = None
+) -> dict | None:
+    """Attribution table for a captured run directory, or None when no
+    trace file holds op events. Newest trace file with op events wins;
+    the HLO map comes from `hlo_text` or the dir's own `*_hlo.txt` dump."""
+    if hlo_text is None:
+        hlo_text = find_hlo_text(trace_dir)
+    op_components = hlo_op_components(hlo_text) if hlo_text else {}
+    for path in reversed(find_trace_files(trace_dir)):
+        try:
+            events = load_trace_events(path)
+        except (OSError, ValueError):
+            continue
+        dev_pids = _device_lane_pids(events)
+        table = attribute_events(events, op_components, dev_pids or None)
+        if table["rows"]:
+            table["trace"] = path
+            table["hlo_map_ops"] = len(op_components)
+            return table
+    return None
+
+

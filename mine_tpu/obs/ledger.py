@@ -1,0 +1,275 @@
+"""Append-only perf ledger: every bench number, durable and comparable.
+
+BENCH_r01–r05 are disconnected snapshot files — several null, none
+comparable without reading five JSONs and guessing whether the workload
+matched. The ledger replaces that with one append-only JSONL file
+(default `perf_ledger.jsonl`, override/disable via $MINE_TPU_PERF_LEDGER):
+every bench run (bench.py, tools/bench_serve.py, tools/bench_accum.py)
+appends one row carrying
+
+  ts, git_rev, metric, value, unit, config_digest (what workload),
+  device + backend_class (what hardware), and the perf vitals —
+  mfu, step_ms, peak_hbm_bytes, p50_ms/p95_ms where they exist.
+
+`check` compares each (metric, config_digest, device, backend_class)
+stream's NEWEST row against the median of its prior rows (the rolling
+baseline) and flags a regression when the newest value moves beyond
+`threshold` in the bad direction — the gate every later perf PR quotes
+(`python tools/perf_ledger.py check`). Fewer than `min_history` prior
+rows => the stream is skipped, never failed: a new workload cannot
+regress against nothing.
+
+Rows are one JSON object per line; appends are a single O_APPEND write so
+concurrent bench processes interleave whole lines. A malformed line (a
+killed writer) is skipped with a note, never a crash — the ledger is an
+instrument.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import time
+from statistics import median
+from typing import Any
+
+DEFAULT_LEDGER = "perf_ledger.jsonl"
+LEDGER_ENV = "MINE_TPU_PERF_LEDGER"
+
+# aux metrics checked alongside `value` when both the newest row and its
+# history carry them; value: higher_is_better
+AUX_METRICS: dict[str, bool] = {
+    "p95_ms": False,
+    "peak_hbm_bytes": False,
+}
+
+
+def ledger_path() -> str | None:
+    """The ledger file benches append to: $MINE_TPU_PERF_LEDGER wins
+    ("0"/"off"/"none" disables), else ./perf_ledger.jsonl."""
+    env = os.environ.get(LEDGER_ENV)
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off", "none", "false"):
+            return None
+        return env
+    return DEFAULT_LEDGER
+
+
+def git_rev(cwd: str | None = None) -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else None
+    except Exception:  # noqa: BLE001 - evidence, not correctness
+        return None
+
+
+def config_digest(workload: dict[str, Any]) -> str:
+    """Short stable digest of the workload knobs that make two rows
+    comparable (shape, batch, planes, ... — NOT the measured values)."""
+    blob = json.dumps(workload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def backend_class(backend_note: str | None) -> str:
+    """'cpu (degraded: ...)' and 'cpu (forced)' are the same hardware
+    class; comparisons key on the class, not the prose."""
+    if not backend_note:
+        return "unknown"
+    return str(backend_note).split()[0].split("(")[0] or "unknown"
+
+
+def make_row(
+    metric: str,
+    value: float | None,
+    workload: dict[str, Any],
+    unit: str = "",
+    higher_is_better: bool = True,
+    **fields: Any,
+) -> dict:
+    """One ledger row; extra perf vitals ride along as plain fields."""
+    row = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_rev": git_rev(),
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "higher_is_better": bool(higher_is_better),
+        "config_digest": config_digest(workload),
+        "workload": workload,
+    }
+    row.update({k: v for k, v in fields.items() if v is not None})
+    row["backend_class"] = backend_class(row.get("backend"))
+    return row
+
+
+def append(path: str, row: dict) -> dict:
+    """Append one row (single write, O_APPEND semantics). Returns the row
+    as written."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    line = json.dumps(row, sort_keys=True)
+    with open(path, "a") as fh:
+        fh.write(line + "\n")
+    return row
+
+
+def read(path: str) -> tuple[list[dict], int]:
+    """(rows, malformed-line count); missing file reads as empty."""
+    rows: list[dict] = []
+    bad = 0
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                if isinstance(row, dict) and "metric" in row:
+                    rows.append(row)
+                else:
+                    bad += 1
+    except FileNotFoundError:
+        pass
+    return rows, bad
+
+
+def stream_key(row: dict) -> tuple:
+    return (
+        row.get("metric"),
+        row.get("config_digest"),
+        row.get("device"),
+        row.get("backend_class", backend_class(row.get("backend"))),
+    )
+
+
+def rolling_baseline(
+    history: list[dict], field: str = "value", window: int = 5
+) -> float | None:
+    """Median of the last `window` non-null `field` values in `history`
+    (oldest-first order preserved from the file)."""
+    vals = [row[field] for row in history
+            if isinstance(row.get(field), (int, float))]
+    if not vals:
+        return None
+    return float(median(vals[-int(window):]))
+
+
+def _verdict_for(
+    name: str, newest: float, baseline: float, higher_is_better: bool,
+    threshold: float,
+) -> dict:
+    if baseline == 0:
+        delta = 0.0
+    elif higher_is_better:
+        delta = (baseline - newest) / abs(baseline)
+    else:
+        delta = (newest - baseline) / abs(baseline)
+    return {
+        "field": name,
+        "value": newest,
+        "baseline": baseline,
+        "vs_baseline": round(newest / baseline, 4) if baseline else None,
+        "regression_delta": round(delta, 4),
+        "regressed": delta > threshold,
+    }
+
+
+def check_rows(
+    rows: list[dict],
+    threshold: float = 0.10,
+    window: int = 5,
+    min_history: int = 2,
+) -> dict:
+    """Newest row of every comparable stream vs its rolling baseline.
+
+    Returns {"ok", "checked": [...], "skipped": [...], "regressions": N}.
+    ok is True when no checked field regressed beyond threshold.
+    """
+    streams: dict[tuple, list[dict]] = {}
+    for row in rows:
+        streams.setdefault(stream_key(row), []).append(row)
+    checked, skipped = [], []
+    regressions = 0
+    for key, stream in streams.items():
+        newest, history = stream[-1], stream[:-1]
+        label = {"metric": key[0], "config_digest": key[1],
+                 "device": key[2], "backend_class": key[3]}
+        usable = [r for r in history
+                  if isinstance(r.get("value"), (int, float))]
+        if len(usable) < min_history:
+            skipped.append({**label, "reason":
+                            f"{len(usable)} prior rows < min_history="
+                            f"{min_history}"})
+            continue
+        if not isinstance(newest.get("value"), (int, float)):
+            skipped.append({**label, "reason": "newest row has no value"})
+            continue
+        fields = [("value", bool(newest.get("higher_is_better", True)))]
+        fields += [
+            (aux, hib) for aux, hib in AUX_METRICS.items()
+            if isinstance(newest.get(aux), (int, float))
+            and rolling_baseline(usable, aux, window) is not None
+        ]
+        verdicts = []
+        for field, hib in fields:
+            baseline = rolling_baseline(usable, field, window)
+            if baseline is None:
+                continue
+            v = _verdict_for(field, float(newest[field]), baseline, hib,
+                             threshold)
+            regressions += int(v["regressed"])
+            verdicts.append(v)
+        checked.append({**label, "history": len(usable),
+                        "fields": verdicts})
+    return {
+        "ok": regressions == 0,
+        "threshold": threshold,
+        "window": window,
+        "min_history": min_history,
+        "checked": checked,
+        "skipped": skipped,
+        "regressions": regressions,
+    }
+
+
+def check(path: str, threshold: float = 0.10, window: int = 5,
+          min_history: int = 2) -> dict:
+    rows, bad = read(path)
+    verdict = check_rows(rows, threshold=threshold, window=window,
+                         min_history=min_history)
+    verdict.update(ledger=path, rows=len(rows), malformed_lines=bad)
+    return verdict
+
+
+def append_bench_row(result_fields: dict, workload: dict,
+                     path: str | None = None) -> dict | None:
+    """The one-call integration the bench tools use: build a row from a
+    bench's emitted fields, append it to the configured ledger, return
+    the row (None when the ledger is disabled). Never raises — a bench
+    must emit its number even when the ledger file is unwritable."""
+    path = ledger_path() if path is None else path
+    if path is None:
+        return None
+    try:
+        row = make_row(workload=workload, **result_fields)
+        append(path, row)
+        return row
+    except Exception as exc:  # noqa: BLE001 - the measurement outranks the ledger
+        # but an unwritable ledger must not masquerade as a disabled one:
+        # without this note the regression gate checks 0 streams forever
+        # and nothing anywhere says why
+        import sys
+
+        print(f"# perf-ledger append to {path} failed: {exc}",
+              file=sys.stderr)
+        return None

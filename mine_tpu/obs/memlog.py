@@ -1,0 +1,151 @@
+"""Live HBM telemetry: poll device.memory_stats() into gauges + counters.
+
+BASELINE's peak-HBM numbers come from XLA's static memory_analysis of one
+executable — exact for that executable, blind to everything else resident
+(params, opt state, cache entries, a second executable's workspace). The
+runtime's own accounting, `device.memory_stats()` (PJRT: bytes_in_use /
+peak_bytes_in_use and friends), sees the whole picture and updates live.
+This module samples it on a cadence the callers own (training: once per
+log interval; serving: per dispatch + per /metrics scrape) and publishes:
+
+  * gauges — `mine_train_hbm_{live,peak}_bytes` /
+    `mine_serve_hbm_{live,peak}_bytes` (max over local devices: the
+    watermark that OOMs first);
+  * Chrome-trace counter events (`ph: "C"`) on the host tracer's clock,
+    so the HBM curve renders as a track next to the step spans in
+    chrome://tracing / Perfetto;
+  * a bounded ring of raw samples the flight recorder snapshots into its
+    meta.json (the "what was resident when it died" evidence).
+
+CPU backends generally return no memory_stats; every probe is guarded and
+an absent stat means absent gauges — never a fabricated 0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from mine_tpu.obs.trace import Tracer
+
+# the Chrome counter track name (one per process lane)
+COUNTER_NAME = "hbm_bytes"
+
+
+def device_memory_stats() -> list[dict]:
+    """memory_stats() of every local device, shaped for sampling. Assumes
+    a jax backend is already up (callers sample from live training/serving
+    loops; the flight recorder keeps its own never-initialize probe)."""
+    import jax
+
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - backend-dependent surface
+            stats = None
+        out.append({"device": str(d), "stats": stats})
+    return out
+
+
+class MemLog:
+    """Bounded-ring HBM sampler over one tracer's clock.
+
+    live_gauge/peak_gauge: utils/metrics.py Gauges (or None). stats_fn is
+    injectable for tests and for backends with no memory_stats.
+    """
+
+    def __init__(
+        self,
+        tracer: Tracer | None = None,
+        live_gauge: Any | None = None,
+        peak_gauge: Any | None = None,
+        max_samples: int = 4096,
+        stats_fn: Callable[[], list[dict]] | None = None,
+    ):
+        self.tracer = tracer
+        self.live_gauge = live_gauge
+        self.peak_gauge = peak_gauge
+        self._stats_fn = stats_fn or device_memory_stats
+        self._lock = threading.Lock()
+        self._samples: deque[dict] = deque(maxlen=int(max_samples))
+        self._epoch = (
+            tracer._epoch if tracer is not None else time.perf_counter()
+        )
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self, step: Any = None) -> dict | None:
+        """Poll every local device once; returns the aggregate sample (or
+        None when no device reports stats). Sets the gauges to the MAX
+        over devices — the device that OOMs first is the number that
+        matters, and on a replicated mesh they agree anyway."""
+        try:
+            per_device = self._stats_fn()
+        except Exception:  # noqa: BLE001 - telemetry must never crash a step
+            return None
+        live = peak = None
+        for entry in per_device:
+            stats = entry.get("stats") or {}
+            b = stats.get("bytes_in_use")
+            p = stats.get("peak_bytes_in_use")
+            if b is not None:
+                live = max(live or 0, int(b))
+            if p is not None:
+                peak = max(peak or 0, int(p))
+        if live is None and peak is None:
+            return None
+        sample = {
+            "ts_us": (time.perf_counter() - self._epoch) * 1e6,
+            "step": step,
+            "live_bytes": live,
+            "peak_bytes": peak,
+            "devices": per_device,
+        }
+        with self._lock:
+            self._samples.append(sample)
+        if self.live_gauge is not None and live is not None:
+            self.live_gauge.set(live)
+        if self.peak_gauge is not None and peak is not None:
+            self.peak_gauge.set(peak)
+        return sample
+
+    # -- reading -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def last(self) -> dict | None:
+        """Newest sample minus the bulky per-device list — what the flight
+        recorder's meta.json snapshots."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = dict(self._samples[-1])
+        s.pop("devices", None)
+        return s
+
+    def counter_events(self, pid: int | None = None) -> list[dict]:
+        """Chrome-trace `C` (counter) events for every sample, on the host
+        tracer's timebase — merged into the host-span export so the HBM
+        curve draws under the step spans."""
+        import os
+
+        pid = os.getpid() if pid is None else pid
+        with self._lock:
+            samples = list(self._samples)
+        events = []
+        for s in samples:
+            args = {}
+            if s["live_bytes"] is not None:
+                args["live"] = s["live_bytes"]
+            if s["peak_bytes"] is not None:
+                args["peak"] = s["peak_bytes"]
+            events.append({
+                "ph": "C", "pid": pid, "tid": 0, "name": COUNTER_NAME,
+                "ts": round(s["ts_us"], 3), "args": args,
+            })
+        return events

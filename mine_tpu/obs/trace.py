@@ -220,9 +220,14 @@ class Tracer:
 
     # -- export --------------------------------------------------------------
 
-    def to_chrome_trace(self, last_k: int | None = None) -> dict:
+    def to_chrome_trace(
+        self, last_k: int | None = None,
+        extra_events: list[dict] | None = None,
+    ) -> dict:
         """Chrome trace-event JSON (dict): `X` duration events per span plus
-        process/thread metadata naming the host lane."""
+        process/thread metadata naming the host lane. extra_events (already
+        on this tracer's timebase — e.g. obs/memlog.py counter events) are
+        appended verbatim so they render in the same lane."""
         pid = os.getpid()
         spans = self.snapshot(last_k)
         events: list[dict] = [{
@@ -246,6 +251,8 @@ class Tracer:
             if s.args:
                 ev["args"] = {k: _jsonable(v) for k, v in s.args.items()}
             events.append(ev)
+        if extra_events:
+            events.extend(extra_events)
         return {
             "displayTimeUnit": "ms",
             "traceEvents": events,
@@ -255,13 +262,16 @@ class Tracer:
             },
         }
 
-    def export(self, path: str, last_k: int | None = None) -> str:
+    def export(
+        self, path: str, last_k: int | None = None,
+        extra_events: list[dict] | None = None,
+    ) -> str:
         """Write the Chrome-trace JSON; name the file `*.trace.json` so
         tools/profile_summary.py's glob finds it next to device traces."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
-            json.dump(self.to_chrome_trace(last_k), fh)
+            json.dump(self.to_chrome_trace(last_k, extra_events), fh)
         os.replace(tmp, path)
         return path
 

@@ -11,7 +11,7 @@ by mine_tpu/parallel/plane_sharding.py with an explicit cross-device prefix.
 from __future__ import annotations
 
 import os
-from functools import partial
+from functools import partial, wraps
 from typing import Callable, NamedTuple
 
 import jax
@@ -30,12 +30,29 @@ _BG_DIST = 1.0e3  # pseudo-distance behind the farthest plane (mpi_rendering.py:
 DEFAULT_STREAM_CHUNK = 4
 
 
+def _scoped(name: str):
+    """Run the wrapped function under jax.named_scope(name) so its XLA ops
+    carry the component in their metadata (obs/attrib.py buckets device
+    time by these names). Pure metadata: a numerics and perf no-op."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
 def _shifted_exclusive(x: Array, fill: float = 1.0) -> Array:
     """[a, b, c] -> [fill, a, b] along the plane axis (axis=1)."""
     ones = jnp.full_like(x[:, :1], fill)
     return jnp.concatenate([ones, x[:, :-1]], axis=1)
 
 
+@_scoped("composite")
 def alpha_composition(alpha: Array, value: Array) -> tuple[Array, Array]:
     """Over-compositing of K planes, nearest first (mpi_rendering.py:23-39).
 
@@ -47,6 +64,7 @@ def alpha_composition(alpha: Array, value: Array) -> tuple[Array, Array]:
     return jnp.sum(value * weights, axis=1), weights
 
 
+@_scoped("composite")
 def weighted_sum_mpi(
     rgb: Array, xyz: Array, weights: Array, is_bg_depth_inf: bool = False
 ) -> tuple[Array, Array]:
@@ -66,6 +84,7 @@ def weighted_sum_mpi(
     return rgb_out, depth_out
 
 
+@_scoped("composite")
 def plane_volume_rendering(
     rgb: Array, sigma: Array, xyz: Array, is_bg_depth_inf: bool = False
 ) -> tuple[Array, Array, Array, Array]:
@@ -146,6 +165,7 @@ def _src_dists(mpi_disparity: Array, k_inv: Array, h: int, w: int) -> Array:
     )
 
 
+@_scoped("composite")
 def weighted_sum_src(
     rgb: Array, mpi_disparity: Array, weights: Array, is_bg_depth_inf: bool = False
 ) -> tuple[Array, Array]:
@@ -170,6 +190,7 @@ def weighted_sum_src(
     return rgb_out, depth_out
 
 
+@_scoped("composite")
 def render_src(
     rgb: Array,
     sigma: Array,
@@ -229,6 +250,7 @@ def _affine_tgt_xyz(
     )
 
 
+@_scoped("homography_warp")
 def plane_tgt_xyz(
     depth: Array, g_tgt_src: Array, k_src_inv: Array, k_tgt: Array,
     h: int, w: int,
@@ -247,6 +269,7 @@ def plane_tgt_xyz(
     return _affine_tgt_xyz(src_xy, depth, g_tgt_src, k_src_inv, h, w)
 
 
+@_scoped("homography_warp")
 def warp_mpi_to_tgt(
     mpi_rgb_src: Array,
     mpi_sigma_src: Array,
@@ -448,36 +471,39 @@ def _stream_scan(
         tgt_rgb, tgt_sigma, tgt_xyz, valid = warp_mpi_to_tgt(
             x["rgb"], x["sigma"], x["disp"], g_tgt_src, k_src_inv, k_tgt
         )
-        z = tgt_xyz[..., 2:3]  # (B, chunk, H, W, 1)
-        if use_alpha:
-            alpha = tgt_sigma
-            trans_local = jnp.cumprod(1.0 - alpha, axis=1)
-        else:
-            xyz_next = plane_tgt_xyz(
-                x["next_depth"], g_tgt_src, k_src_inv, k_tgt, h, w
-            )
-            xyz_ext = jnp.concatenate([tgt_xyz, xyz_next[:, None]], axis=1)
-            diff = xyz_ext[:, 1:] - xyz_ext[:, :-1]
-            # the background slot's diff must be replaced BEFORE the norm
-            # (d||v||/dv at v=0 is 0/0 — same NaN-cotangent guard as
-            # parallel/plane_sharding.py)
-            bg_mask = jnp.logical_and(
-                jnp.logical_and(x["is_last"], bg_on_last), last_plane
-            )
-            diff = jnp.where(bg_mask, 1.0, diff)
-            dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)
-            dist = jnp.where(bg_mask, _BG_DIST, dist)
-            transparency = jnp.exp(-tgt_sigma * dist)
-            alpha = 1.0 - transparency
-            trans_local = jnp.cumprod(transparency + 1.0e-6, axis=1)
-        weights = t_acc[:, None] * _shifted_exclusive(trans_local) * alpha
-        return (
-            rgb_acc + jnp.sum(weights * tgt_rgb, axis=1),
-            z_acc + jnp.sum(weights * z, axis=1),
-            w_acc + jnp.sum(weights, axis=1),
-            m_acc + jnp.sum(valid.astype(mpi_rgb_src.dtype), axis=1),
-            t_acc * trans_local[:, -1],
-        ), None
+        # everything past the warp is compositing math (the warp call above
+        # carries its own homography_warp scope)
+        with jax.named_scope("composite"):
+            z = tgt_xyz[..., 2:3]  # (B, chunk, H, W, 1)
+            if use_alpha:
+                alpha = tgt_sigma
+                trans_local = jnp.cumprod(1.0 - alpha, axis=1)
+            else:
+                xyz_next = plane_tgt_xyz(
+                    x["next_depth"], g_tgt_src, k_src_inv, k_tgt, h, w
+                )
+                xyz_ext = jnp.concatenate([tgt_xyz, xyz_next[:, None]], axis=1)
+                diff = xyz_ext[:, 1:] - xyz_ext[:, :-1]
+                # the background slot's diff must be replaced BEFORE the norm
+                # (d||v||/dv at v=0 is 0/0 — same NaN-cotangent guard as
+                # parallel/plane_sharding.py)
+                bg_mask = jnp.logical_and(
+                    jnp.logical_and(x["is_last"], bg_on_last), last_plane
+                )
+                diff = jnp.where(bg_mask, 1.0, diff)
+                dist = jnp.linalg.norm(diff, axis=-1, keepdims=True)
+                dist = jnp.where(bg_mask, _BG_DIST, dist)
+                transparency = jnp.exp(-tgt_sigma * dist)
+                alpha = 1.0 - transparency
+                trans_local = jnp.cumprod(transparency + 1.0e-6, axis=1)
+            weights = t_acc[:, None] * _shifted_exclusive(trans_local) * alpha
+            return (
+                rgb_acc + jnp.sum(weights * tgt_rgb, axis=1),
+                z_acc + jnp.sum(weights * z, axis=1),
+                w_acc + jnp.sum(weights, axis=1),
+                m_acc + jnp.sum(valid.astype(mpi_rgb_src.dtype), axis=1),
+                t_acc * trans_local[:, -1],
+            ), None
 
     dtype = mpi_rgb_src.dtype
     init = (
@@ -563,34 +589,36 @@ def _fused_forward(
     from mine_tpu.ops.pallas.warp import warp_composite_chw
 
     b, s, h, w, _ = mpi_rgb_src.shape
-    depth = (1.0 / mpi_disparity_src).reshape(b * s)
-    tile = lambda m: jnp.repeat(m, s, axis=0)
-    g_flat = tile(g_tgt_src)
-    k_inv_flat = tile(k_src_inv)
-    src_xy, _ = homography_sample_coords(
-        depth, g_flat, k_inv_flat, tile(k_tgt), h, w
-    )
-    xyz = _affine_tgt_xyz(src_xy, depth, g_flat, k_inv_flat, h, w)
-    xyz = xyz.reshape(b, s, h, w, 3)
-    dist = jnp.linalg.norm(xyz[:, 1:] - xyz[:, :-1], axis=-1)
-    dist = jnp.concatenate(
-        [dist, jnp.full_like(dist[:, :1], _BG_DIST)], axis=1
-    )  # (B, S, H, W)
+    with jax.named_scope("homography_warp"):
+        depth = (1.0 / mpi_disparity_src).reshape(b * s)
+        tile = lambda m: jnp.repeat(m, s, axis=0)  # noqa: E731
+        g_flat = tile(g_tgt_src)
+        k_inv_flat = tile(k_src_inv)
+        src_xy, _ = homography_sample_coords(
+            depth, g_flat, k_inv_flat, tile(k_tgt), h, w
+        )
+        xyz = _affine_tgt_xyz(src_xy, depth, g_flat, k_inv_flat, h, w)
+        xyz = xyz.reshape(b, s, h, w, 3)
+        dist = jnp.linalg.norm(xyz[:, 1:] - xyz[:, :-1], axis=-1)
+        dist = jnp.concatenate(
+            [dist, jnp.full_like(dist[:, :1], _BG_DIST)], axis=1
+        )  # (B, S, H, W)
 
-    payload = jnp.concatenate([mpi_rgb_src, mpi_sigma_src], axis=-1)
-    payload = jnp.moveaxis(payload, -1, 2)  # (B, S, 4, H, W)
-    coords = src_xy.reshape(b, s, h, w, 2)
-    acc = warp_composite_chw(
-        payload, coords[..., 0], coords[..., 1], dist, xyz[..., 2],
-        interpret=_FORCE_FUSED_INTERPRET,
-    )  # (B, 7, H, W): rgb(3), z_sum, w_sum, valid count, transmittance
-    rgb_out = jnp.moveaxis(acc[:, 0:3], 1, -1)
-    z_sum = acc[:, 3][..., None]
-    w_sum = acc[:, 4][..., None]
-    mask = acc[:, 5][..., None]
-    depth_out = _finalize_depth(
-        z_sum, w_sum, use_alpha=False, is_bg_depth_inf=is_bg_depth_inf
-    )
+    with jax.named_scope("composite"):
+        payload = jnp.concatenate([mpi_rgb_src, mpi_sigma_src], axis=-1)
+        payload = jnp.moveaxis(payload, -1, 2)  # (B, S, 4, H, W)
+        coords = src_xy.reshape(b, s, h, w, 2)
+        acc = warp_composite_chw(
+            payload, coords[..., 0], coords[..., 1], dist, xyz[..., 2],
+            interpret=_FORCE_FUSED_INTERPRET,
+        )  # (B, 7, H, W): rgb(3), z_sum, w_sum, valid count, transmittance
+        rgb_out = jnp.moveaxis(acc[:, 0:3], 1, -1)
+        z_sum = acc[:, 3][..., None]
+        w_sum = acc[:, 4][..., None]
+        mask = acc[:, 5][..., None]
+        depth_out = _finalize_depth(
+            z_sum, w_sum, use_alpha=False, is_bg_depth_inf=is_bg_depth_inf
+        )
     return rgb_out, depth_out, mask
 
 

@@ -166,7 +166,10 @@ def shard_update(
             return u
         return lax.all_gather(u, axis_name, axis=d, tiled=True)
 
-    updates = jax.tree.map(gather, updates_local, dims)
+    # component scope (obs/attrib.py): the added ZeRO-1 traffic is its own
+    # attribution bucket, distinct from the elementwise optimizer math
+    with jax.named_scope("zero1_gather"):
+        updates = jax.tree.map(gather, updates_local, dims)
     return updates, new_opt_local
 
 

@@ -77,11 +77,20 @@ class _Pending:
     entry: MPIEntry
     poses: np.ndarray
     deadline: float | None = None  # monotonic; None = no deadline
+    request_id: str | None = None  # X-Request-Id for span attribution
     future: Future = field(default_factory=Future)
     enqueued_at: float = field(default_factory=time.monotonic)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
+
+
+def _ids(group: list[_Pending]) -> str | None:
+    """Comma-joined request ids of a group's members (span attribution:
+    server.trace_for_request splits this back); None when no member
+    carried one — absent beats an empty-string arg in every span."""
+    ids = [p.request_id for p in group if p.request_id]
+    return ",".join(ids) if ids else None
 
 
 class MicroBatcher:
@@ -157,16 +166,20 @@ class MicroBatcher:
         entry: MPIEntry,
         poses: np.ndarray,
         deadline: float | None = None,
+        request_id: str | None = None,
     ) -> Future:
         """Enqueue one render request; resolves to (rgb, disp) host arrays.
 
         deadline: monotonic-clock instant after which the request must NOT
         be dispatched — the worker fails it with DeadlineExceeded instead.
+        request_id: trace attribution only — a coalesced dispatch's spans
+        carry every member's id, so /debug/trace?request_id= finds them.
         """
         poses = np.asarray(poses, np.float32)
         if poses.ndim != 3 or poses.shape[1:] != (4, 4):
             raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")
-        item = _Pending(key=key, entry=entry, poses=poses, deadline=deadline)
+        item = _Pending(key=key, entry=entry, poses=poses, deadline=deadline,
+                        request_id=request_id)
         with self._cond:
             if self._stop:
                 raise BatcherStopped()
@@ -267,6 +280,7 @@ class MicroBatcher:
                 self._tracer.record(
                     "coalesce", "serve", coalesce_t0, time.perf_counter(),
                     requests=len(group), poses=n_poses,
+                    request_ids=_ids(group),
                 )
                 return group
         finally:
@@ -305,10 +319,11 @@ class MicroBatcher:
         age = now - group[0].enqueued_at
         t1 = time.perf_counter()
         self._tracer.record("queue_wait", "serve", t1 - age, t1,
-                            requests=len(group))
+                            requests=len(group), request_ids=_ids(group))
         try:
             with self._tracer.span("dispatch", cat="serve",
-                                   poses=poses.shape[0]):
+                                   poses=poses.shape[0],
+                                   request_ids=_ids(group)):
                 rgb, disp = self._render_fn(group[0].entry, poses)
         except BaseException as exc:  # noqa: BLE001 - forwarded to callers
             for p in group:

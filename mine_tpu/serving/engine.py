@@ -42,6 +42,7 @@ import numpy as np
 
 from mine_tpu.config import Config
 from mine_tpu.obs.cost import StepCost, compiled_cost, resolve_peak_flops
+from mine_tpu.obs.trace import NULL_TRACER, Tracer
 from mine_tpu.resilience import chaos
 from mine_tpu.serving.cache import MPIEntry
 from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
@@ -181,6 +182,7 @@ class RenderEngine:
         fov_deg: float = 90.0,
         compositor: str = "streaming",
         peak_flops_override: float = 0.0,
+        tracer: Tracer | None = None,
     ):
         import jax
 
@@ -209,6 +211,10 @@ class RenderEngine:
         )
         self.checkpoint_step = int(checkpoint_step)
         self.metrics = metrics
+        # request-scoped spans (X-Request-Id): predict/render dispatches
+        # land in the same ring the HTTP handler spans use, so
+        # /debug/trace?request_id= can stitch one request's full tree
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.pose_buckets = tuple(sorted(set(int(n) for n in pose_buckets)))
         if not self.pose_buckets or self.pose_buckets[0] < 1:
             raise ValueError(f"bad pose_buckets {pose_buckets}")
@@ -271,7 +277,8 @@ class RenderEngine:
     # -- the two halves ------------------------------------------------------
 
     def predict(
-        self, image: np.ndarray, spec: BucketSpec | None = None
+        self, image: np.ndarray, spec: BucketSpec | None = None,
+        request_id: str | None = None,
     ) -> MPIEntry:
         """Run the encoder-decoder once; returns a device-resident MPIEntry.
 
@@ -284,15 +291,20 @@ class RenderEngine:
         chaos.maybe_raise("predict_raise")  # fault seam (resilience/chaos.py)
         bucket = self.bucket(spec)
         h, w, _ = bucket.spec
-        img = prepare_image(image, h, w)
-        exe = bucket.predict_executable()
-        if bucket.is_c2f:
-            mpi_rgb, mpi_sigma, disparity = exe(self.variables, img, bucket.k)
-        else:
-            mpi_rgb, mpi_sigma = exe(
-                self.variables, img, bucket.disparity, bucket.k
-            )
-            disparity = bucket.disparity
+        with self.tracer.span("engine_predict", cat="serve",
+                              bucket=str(bucket.spec),
+                              request_id=request_id):
+            img = prepare_image(image, h, w)
+            exe = bucket.predict_executable()
+            if bucket.is_c2f:
+                mpi_rgb, mpi_sigma, disparity = exe(
+                    self.variables, img, bucket.k
+                )
+            else:
+                mpi_rgb, mpi_sigma = exe(
+                    self.variables, img, bucket.disparity, bucket.k
+                )
+                disparity = bucket.disparity
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
             if bucket.predict_cost is not None and bucket.predict_cost.flops:

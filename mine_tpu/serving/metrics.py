@@ -145,6 +145,19 @@ class ServingMetrics:
             "achieved TFLOP/s of the last render dispatch",
         )
 
+        # live HBM telemetry (obs/memlog.py; sampled per dispatch and per
+        # /metrics scrape; absent on backends without memory_stats)
+        self.hbm_live_bytes = r.gauge(
+            "mine_serve_hbm_live_bytes",
+            "device.memory_stats() bytes_in_use, max over local devices",
+        )
+        self.hbm_peak_bytes = r.gauge(
+            "mine_serve_hbm_peak_bytes",
+            "device.memory_stats() peak_bytes_in_use, max over local "
+            "devices — the runtime high-water mark the cache byte budget "
+            "and bucket set must stay under",
+        )
+
         # MPI cache
         self.cache_hits = r.counter(
             "mine_serve_cache_hits_total", "MPI cache hits")

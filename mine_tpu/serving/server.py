@@ -49,8 +49,11 @@ import hashlib
 import io
 import json
 import os
+import re
 import threading
 import time
+import uuid
+from urllib.parse import parse_qs
 from concurrent.futures import Future, TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
@@ -58,6 +61,7 @@ from typing import Any
 import numpy as np
 
 from mine_tpu.config import Config
+from mine_tpu.obs.memlog import MemLog
 from mine_tpu.obs.trace import Tracer
 from mine_tpu.resilience import BreakerOpen, CircuitBreaker
 from mine_tpu.serving.batcher import (
@@ -166,10 +170,18 @@ class ServingApp:
             enabled=trace_enabled, max_spans=trace_buffer_spans,
             on_span=lambda span: self.metrics.trace_spans.inc(cat=span.cat),
         )
+        # live HBM gauges (obs/memlog.py): sampled after each engine
+        # dispatch and on every /metrics scrape
+        self.memlog = MemLog(
+            tracer=self.tracer,
+            live_gauge=self.metrics.hbm_live_bytes,
+            peak_gauge=self.metrics.hbm_peak_bytes,
+        )
         self.engine = RenderEngine(
             cfg, params, batch_stats, checkpoint_step=checkpoint_step,
             metrics=self.metrics, fov_deg=fov_deg,
             peak_flops_override=peak_flops_override,
+            tracer=self.tracer,
         )
         # shapes an untrusted /predict body may request: each admitted spec
         # costs a full XLA compile + an O(S*H*W) resident MPI, so the set is
@@ -213,12 +225,16 @@ class ServingApp:
             self.breaker.record_failure()
             raise
         self.breaker.record_success()
+        self.memlog.sample()  # HBM watermark after the dispatch
         return result
 
     def _guarded_render(self, entry, poses):
         return self._breaker_guard("render", self.engine.render, entry, poses)
 
-    def predict(self, image_bytes: bytes, spec: BucketSpec | None = None) -> dict:
+    def predict(
+        self, image_bytes: bytes, spec: BucketSpec | None = None,
+        request_id: str | None = None,
+    ) -> dict:
         digest = hashlib.sha256(image_bytes).hexdigest()
         if spec is not None:
             spec = tuple(int(v) for v in spec)
@@ -240,7 +256,9 @@ class ServingApp:
                 "mpi_bytes": entry.nbytes,
             }
 
-        entry = self.cache.get(key)
+        with self.tracer.span("cache_lookup", cat="serve", endpoint="predict",
+                              request_id=request_id):
+            entry = self.cache.get(key)
         if entry is not None:
             return response(entry, cached=True)
         with self._inflight_lock:
@@ -272,7 +290,8 @@ class ServingApp:
             # client's fault (400) and must not count as engine failures
             image = _decode_image(image_bytes)
             entry = self._breaker_guard(
-                "predict", self.engine.predict, image, bucket.spec
+                "predict", self.engine.predict, image, bucket.spec,
+                request_id,
             )
             self.cache.put(key, entry)
             future.set_result(entry)
@@ -289,9 +308,12 @@ class ServingApp:
         key_str: str,
         poses: np.ndarray,
         timeout_s: float | None = None,
+        request_id: str | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         key = key_from_str(key_str)
-        entry = self.cache.get(key)
+        with self.tracer.span("cache_lookup", cat="serve", endpoint="render",
+                              request_id=request_id):
+            entry = self.cache.get(key)
         if entry is None:
             raise KeyError(key_str)
         if self.breaker.rejecting():
@@ -306,7 +328,8 @@ class ServingApp:
             self.request_timeout_s,
         )
         future = self.batcher.submit(
-            key, entry, poses, deadline=time.monotonic() + timeout
+            key, entry, poses, deadline=time.monotonic() + timeout,
+            request_id=request_id,
         )
         try:
             return future.result(timeout=timeout)
@@ -319,6 +342,26 @@ class ServingApp:
             raise RequestTimeout(
                 f"render did not complete within {timeout:.1f}s"
             ) from None
+
+    def trace_for_request(self, request_id: str) -> dict:
+        """One request's span tree as Chrome-trace JSON: every span whose
+        args carry this request_id — the handler-side parse/predict/render/
+        cache_lookup/encode spans plus the batcher/engine spans of any
+        dispatch that included it (their request_ids list)."""
+        doc = self.tracer.to_chrome_trace()
+        kept = []
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "M":
+                kept.append(ev)
+                continue
+            args = ev.get("args") or {}
+            if args.get("request_id") == request_id:
+                kept.append(ev)
+            elif request_id in str(args.get("request_ids", "")).split(","):
+                kept.append(ev)
+        doc["traceEvents"] = kept
+        doc["metadata"]["request_id"] = request_id
+        return doc
 
     def health(self) -> dict:
         import jax
@@ -379,6 +422,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
+        # every response names its request: the id the client sent (or the
+        # one minted for it) keys /debug/trace?request_id=
+        rid = getattr(self, "request_id", None)
+        if rid:
+            self.send_header("X-Request-Id", rid)
         for name, value in (extra_headers or {}).items():
             self.send_header(name, value)
         self.end_headers()
@@ -442,11 +490,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(code, health)
             return code, "healthz"
         if method == "GET" and path == "/metrics":
+            # scrape-cadence HBM sample: the gauges stay current even when
+            # no dispatch has run since the last scrape (obs/memlog.py)
+            app.memlog.sample()
             self._send(200, app.metrics.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
             return 200, "metrics"
         if method == "GET" and path == "/debug/trace":
-            self._send_json(200, app.tracer.to_chrome_trace())
+            query = parse_qs(self.path.partition("?")[2])
+            rid = (query.get("request_id") or [None])[0]
+            if rid:
+                self._send_json(200, app.trace_for_request(rid))
+            else:
+                self._send_json(200, app.tracer.to_chrome_trace(
+                    extra_events=app.memlog.counter_events()
+                ))
             return 200, "debug_trace"
         if method == "POST" and path == "/predict":
             return self._predict(app), "predict"
@@ -455,9 +513,23 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, {"error": f"no route {method} {path}"})
         return 404, "unknown"
 
+    # X-Request-Id charset guard: an id is echoed into a response header
+    # and span args, so a hostile value must not smuggle newlines or blow
+    # up the ring — anything outside this alphabet gets a minted id
+    _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+    def _resolve_request_id(self) -> str:
+        """The client's X-Request-Id when well-formed, else a minted one —
+        every request gets an id, so every span tree is addressable."""
+        rid = self.headers.get("X-Request-Id", "")
+        if self._REQUEST_ID_RE.match(rid):
+            return rid
+        return uuid.uuid4().hex[:16]
+
     def _handle(self, method: str) -> None:
         app = self.server.app
         path = self.path.split("?", 1)[0]
+        self.request_id = self._resolve_request_id()
         t0 = time.monotonic()
         try:
             code, endpoint = self._route(method, path)
@@ -490,7 +562,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- endpoints -----------------------------------------------------------
 
     def _predict(self, app: ServingApp) -> int:
-        with app.tracer.span("parse", cat="serve", endpoint="predict"):
+        rid = self.request_id
+        with app.tracer.span("parse", cat="serve", endpoint="predict",
+                             request_id=rid):
             body = self._read_body()
             spec = None
             ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
@@ -509,8 +583,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": "empty image"})
             return 400
         try:
-            with app.tracer.span("predict", cat="serve"):
-                result = app.predict(image_bytes, spec)
+            with app.tracer.span("predict", cat="serve", request_id=rid):
+                result = app.predict(image_bytes, spec, request_id=rid)
         except (BreakerOpen, RequestTimeout) as exc:
             return self._overload_response(exc)
         except (ValueError, OSError) as exc:
@@ -522,8 +596,10 @@ class _Handler(BaseHTTPRequestHandler):
         return 200
 
     def _render(self, app: ServingApp) -> int:
+        rid = self.request_id
         try:
-            with app.tracer.span("parse", cat="serve", endpoint="render"):
+            with app.tracer.span("parse", cat="serve", endpoint="render",
+                                 request_id=rid):
                 req = json.loads(self._read_body())
                 key_str = req["mpi_key"]
                 key_from_str(key_str)  # malformed keys are a 400, not a 500
@@ -535,7 +611,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad render body: {exc}"})
             return 400
         try:
-            rgb, disp = app.render(key_str, poses, timeout_s=timeout_s)
+            rgb, disp = app.render(key_str, poses, timeout_s=timeout_s,
+                                   request_id=rid)
         except (BreakerOpen, QueueFull, BatcherStopped, DeadlineExceeded,
                 RequestTimeout) as exc:
             # overload/drain/deadline: honest 503/504, never a hang or 500
@@ -549,7 +626,7 @@ class _Handler(BaseHTTPRequestHandler):
         from mine_tpu.inference.video import normalize_disparity, to_uint8
 
         with app.tracer.span("encode", cat="serve",
-                             frames=int(rgb.shape[0])):
+                             frames=int(rgb.shape[0]), request_id=rid):
             frames = [
                 base64.b64encode(_encode_png(f)).decode()
                 for f in to_uint8(np.clip(rgb, 0.0, 1.0))
@@ -669,7 +746,9 @@ def main(argv: list[str] | None = None) -> None:
     flight = FlightRecorder(
         os.path.join(local_sidecar_dir(args.workspace), "flight"),
         tracer=app.tracer,
-        get_status=lambda: app.health(),
+        # health + the last HBM sample (obs/memlog.py): what was resident
+        # when it died rides every dump's meta.json
+        get_status=lambda: {**app.health(), "hbm": app.memlog.last()},
     ).start()
     if not args.no_warmup:
         built = app.engine.warmup(specs=sorted(app.allowed_buckets))

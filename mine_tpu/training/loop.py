@@ -32,7 +32,8 @@ import numpy as np
 from mine_tpu.config import Config
 from mine_tpu.data import prefetch
 from mine_tpu.losses import load_lpips_params
-from mine_tpu.obs import FlightRecorder, Tracer
+from mine_tpu.obs import FlightRecorder, MemLog, Tracer
+from mine_tpu.obs.attrib import attach_cost_estimates, attribute_profile_dir
 from mine_tpu.obs.cost import (
     achieved_fraction,
     compiled_cost,
@@ -158,6 +159,30 @@ class TrainObsMetrics:
             "forward+backward (step_flops stays per UPDATE — the two "
             "gauges exist so neither is double-counted into the other)",
         )
+        self.component_time_ms = r.gauge(
+            "mine_train_component_time_ms",
+            "device time per named component over the last captured "
+            "profile window (obs/attrib.py; labels: component — encoder/"
+            "decoder/homography_warp/composite/losses/optimizer/"
+            "zero1_gather, plus the unattributed remainder)",
+        )
+        self.attrib_coverage = r.gauge(
+            "mine_train_attrib_coverage",
+            "fraction of profiled device time attributed to a named "
+            "component (the table is only trustworthy >= 0.9)",
+        )
+        self.hbm_live_bytes = r.gauge(
+            "mine_train_hbm_live_bytes",
+            "device.memory_stats() bytes_in_use, max over local devices, "
+            "sampled each log interval (obs/memlog.py; absent on backends "
+            "without memory stats)",
+        )
+        self.hbm_peak_bytes = r.gauge(
+            "mine_train_hbm_peak_bytes",
+            "device.memory_stats() peak_bytes_in_use, max over local "
+            "devices — the runtime's own high-water mark, unlike the "
+            "per-executable memory_analysis figure",
+        )
 
 
 class Trainer:
@@ -178,6 +203,13 @@ class Trainer:
             enabled=cfg.obs.enabled, max_spans=cfg.obs.trace_buffer_spans
         )
         self.obs_metrics = TrainObsMetrics()
+        # HBM telemetry rides the obs switch like the tracer: a disabled
+        # memlog is never sampled (obs/memlog.py)
+        self.memlog = MemLog(
+            tracer=self.tracer,
+            live_gauge=self.obs_metrics.hbm_live_bytes,
+            peak_gauge=self.obs_metrics.hbm_peak_bytes,
+        )
         self._progress: dict[str, Any] = {}
         self.flight: FlightRecorder | None = None
         if cfg.obs.enabled:
@@ -427,6 +459,8 @@ class Trainer:
                 "step_flops": m.step_flops.value(),
                 "imgs_per_sec": m.imgs_per_sec.value(),
             },
+            # what was resident when it died (obs/memlog.py)
+            "hbm": self.memlog.last(),
         }
 
     def _host_trace_path(self) -> str:
@@ -439,7 +473,12 @@ class Trainer:
         if not self.tracer.enabled or not len(self.tracer):
             return
         try:
-            self.tracer.export(self._host_trace_path())
+            # HBM counter samples ride the host lane as Chrome `C` events,
+            # so the memory curve draws under the step spans
+            self.tracer.export(
+                self._host_trace_path(),
+                extra_events=self.memlog.counter_events(),
+            )
         except OSError:
             self.logger.exception("host trace export failed")
 
@@ -482,6 +521,7 @@ class Trainer:
                 "on the jit path without MFU gauges"
             )
             return train_step
+        self._dump_step_hlo(compiled)
         self._peak_flops = resolve_peak_flops(
             jax.devices()[0], cfg.obs.peak_flops_override
         )
@@ -503,6 +543,62 @@ class Trainer:
             self._peak_flops,
         )
         return compiled
+
+    def _dump_step_hlo(self, compiled) -> None:
+        """Write the compiled step's HLO text next to the profile dir: the
+        instruction -> named-scope map obs/attrib.py joins device-trace op
+        events against (CPU op events carry only the HLO instruction name;
+        the scope lives in this file's metadata)."""
+        try:
+            path = os.path.join(
+                self.local_dir, "profile", "train_step_hlo.txt"
+            )
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as fh:
+                fh.write(compiled.as_text())
+        except Exception:  # noqa: BLE001 - instrument, never a crash
+            self.logger.exception("train-step HLO dump failed")
+
+    def _publish_attribution(self, global_step: int) -> None:
+        """After a profile window closes: join the captured device trace
+        with the step HLO into the per-component table, publish the
+        mine_train_component_time_ms gauges, and log coverage — the
+        attribution the MFU-climb item optimizes against."""
+        try:
+            table = attribute_profile_dir(os.path.join(self.local_dir, "profile"))
+        except Exception:  # noqa: BLE001 - instrument, never a crash
+            self.logger.exception("profile attribution failed")
+            return
+        if table is None or not table["rows"]:
+            self.logger.info(
+                "profile attribution: no op events in the captured trace "
+                "(backend emits none?) — component gauges not set"
+            )
+            return
+        if self._train_cost is not None:
+            # time/FLOPs/bytes table (labeled estimates: the executable's
+            # cost_analysis totals split by measured time share)
+            attach_cost_estimates(
+                table, self._train_cost.flops, self._train_cost.bytes_accessed
+            )
+        m = self.obs_metrics
+        for row in table["rows"]:
+            m.component_time_ms.set(row["time_ms"], component=row["component"])
+            self.writer.scalar(
+                f"obs/component_{row['component']}_ms", row["time_ms"],
+                global_step,
+            )
+        m.attrib_coverage.set(table["coverage"])
+        self.writer.scalar("obs/attrib_coverage", table["coverage"], global_step)
+        self.logger.info(
+            "profile attribution (coverage %.1f%%%s): %s",
+            100.0 * table["coverage"],
+            "" if table["covered"] else " — BELOW the 90% accounting bar",
+            " ".join(
+                f"{r['component']}={r['time_ms']:.1f}ms({r['pct']}%)"
+                for r in table["rows"]
+            ),
+        )
 
     def _publish_mfu(self, step_seconds: float, global_step: int) -> None:
         cost = self._train_cost
@@ -660,6 +756,15 @@ class Trainer:
                     jax.profiler.stop_trace()
                     self._export_host_trace()
                     self.logger.info("profile trace written to %s/profile", self.local_dir)
+                    # stop_trace's xplane post-processing plus the trace
+                    # parse below legitimately take minutes on CPU; beat
+                    # the stall watchdog around them so a profile window
+                    # cannot read as a hung step
+                    if self.flight is not None:
+                        self.flight.heartbeat(step=global_step)
+                    self._publish_attribution(global_step)
+                    if self.flight is not None:
+                        self.flight.heartbeat(step=global_step)
 
                 if step_in_epoch % cfg.training.log_interval == 0:
                     # one transfer for the whole dict: per-key float() would
@@ -702,6 +807,10 @@ class Trainer:
                                 "train/grad_norm", float(grad_norm), global_step
                             )
                         self._publish_mfu(interval_s / n_steps, global_step)
+                        if cfg.obs.enabled:
+                            # live HBM gauges + the counter-event curve the
+                            # host-trace export draws (obs/memlog.py)
+                            self.memlog.sample(step=global_step)
                     if tracer.enabled:
                         # AFTER the log span closes, so this interval's own
                         # sync/log phases are in the summary it publishes

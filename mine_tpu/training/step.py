@@ -507,11 +507,15 @@ def loss_fcn(
     scale_factor = None
     loss_dicts, viz_dicts = [], []
     for scale in scales:
-        ld, vz, scale_factor = loss_fcn_per_scale(
-            cfg, scale, batch, mpis[scale], disparity, scale_factor,
-            is_val=is_val, lpips_params=lpips_params, compositor=compositor,
-            per_example=per_example,
-        )
+        # component scope (obs/attrib.py): everything per-scale that is not
+        # inside the warp/composite scopes ops/mpi_render.py sets attributes
+        # to "losses"; the nested scopes win for their own ops
+        with jax.named_scope("losses"):
+            ld, vz, scale_factor = loss_fcn_per_scale(
+                cfg, scale, batch, mpis[scale], disparity, scale_factor,
+                is_val=is_val, lpips_params=lpips_params, compositor=compositor,
+                per_example=per_example,
+            )
         loss_dicts.append(ld)
         viz_dicts.append(vz)
 
@@ -729,10 +733,13 @@ def make_train_step(
         grads = reduce_grads(grads)
         if axis_name is not None:
             loss_dict = lax.pmean(loss_dict, axis_name)
-        updates, new_opt_state = apply_update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
+        # component scope (obs/attrib.py): the update math; the ZeRO-1
+        # all_gather inside carries its own zero1_gather scope
+        with jax.named_scope("optimizer"):
+            updates, new_opt_state = apply_update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         # post-reduction, so every replica computes the identical norm and
         # the identical finite verdict (a NaN anywhere pmean-poisons all)
         grad_norm = optax.global_norm(grads)

@@ -17,6 +17,16 @@ from __graft_entry__ import _force_virtual_cpu_mesh
 
 _force_virtual_cpu_mesh(8)
 
+# The perf ledger (mine_tpu/obs/ledger.py) is append-only: without this,
+# every bench smoke (and the subprocesses they spawn — the env is
+# inherited) would append tiny-workload test rows to whatever ledger the
+# environment points at (the developer's real one, or ./perf_ledger.jsonl)
+# and the regression gate would grade that noise. Unconditional on purpose:
+# setdefault would not protect a developer who exported the variable for
+# real bench runs. Tests that exercise the ledger pass explicit paths or
+# monkeypatch this variable.
+os.environ["MINE_TPU_PERF_LEDGER"] = "off"
+
 import numpy as np
 import pytest
 
@@ -39,6 +49,51 @@ def load_shipped_config(*names, **kw):
     return load_config(
         *(os.path.join(CONFIGS_DIR, n + ".yaml") for n in names), **kw
     )
+
+
+@pytest.fixture(scope="session")
+def tiny_train_setup():
+    """THE shared compiled-step fixture: one tiny-model train-step compile
+    for the whole session (ROADMAP re-anchor note — tier-1 runs at ~841s
+    of the 870s budget, and a train-step compile is ~30s on this box, so
+    every module that needs a compiled step must share this one instead of
+    building its own). Returns (cfg, state0, step_fn, batch_at). Users:
+    tests/test_resilience.py (sentinel mask, signal-save resume, slow
+    ZeRO-1 round-trip). Tests must not mutate state0; batch_at(i) builds a
+    fresh deterministic batch per index."""
+    import jax
+
+    from mine_tpu.config import Config
+    from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.training import (
+        build_model,
+        init_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": 1,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2,
+        "resilience.sentinel_policy": "skip",
+    })
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    state0 = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+    step_fn = jax.jit(make_train_step(cfg, model, tx))
+
+    def batch_at(i: int):
+        import jax.numpy as jnp
+
+        b = make_synthetic_batch(1, 128, 128, n_points=16, seed=100 + i)
+        b.pop("src_depth")
+        return {k: jnp.asarray(v) for k, v in b.items()}
+
+    return cfg, state0, step_fn, batch_at
 
 
 def tree_equal(a, b) -> bool:

@@ -1,0 +1,424 @@
+"""Perf attribution layer tests (mine_tpu/obs/attrib.py, ledger.py,
+memlog.py + the tools that consume them). Everything here is synthetic —
+no XLA compile: the HLO text and Chrome-trace events are hand-built
+fixtures shaped exactly like the real artifacts (the toy shapes were
+captured from a live jax.profiler run on the CPU backend). The one real
+end-to-end capture lives in tests/test_obs.py's obs-enabled training run,
+which asserts the >= 90% coverage bar on the compiled step it already
+pays for."""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+from mine_tpu.obs import attrib, ledger
+from mine_tpu.obs.memlog import MemLog
+
+# ------------------------------------------------------------ component_of
+
+
+def test_component_of_scope_paths():
+    # innermost annotated scope wins (losses wraps the render scopes)
+    assert attrib.component_of(
+        "jit(train_step)/losses/composite/reduce_sum") == "composite"
+    assert attrib.component_of(
+        "jit(train_step)/losses/sub/add") == "losses"
+    # transform wrappers strip: backward ops attribute with their forward
+    assert attrib.component_of(
+        "jit(train_step)/transpose(jvp(encoder))/conv") == "encoder"
+    assert attrib.component_of(
+        "jit(train_step)/jvp(decoder)/dot_general") == "decoder"
+    # flax's module name for the encoder
+    assert attrib.component_of("jit(f)/backbone/conv") == "encoder"
+    assert attrib.component_of("jit(f)/zero1_gather/all-gather") == "zero1_gather"
+    # unscoped paths and empty input resolve to None, never a guess
+    assert attrib.component_of("jit(f)/jit(main)/convert_element_type") is None
+    assert attrib.component_of(None) is None
+    assert attrib.component_of("") is None
+
+
+# -------------------------------------------------------------- HLO parsing
+
+# shaped like a real XLA:CPU dump: a fused computation whose inner ops
+# carry metadata, a metadata-less parallel `call` wrapper, and a
+# metadata-less reduce-window that only an operand chain can resolve
+_HLO = """\
+HloModule jit_f
+
+%fused_tanh (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %tanh.5 = f32[8]{0} tanh(f32[8]{0} %p0), metadata={op_name="jit(f)/encoder/tanh"}
+}
+
+%region_add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add.9 = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main.12 (Arg_0.1: f32[8]) -> f32[] {
+  %Arg_0.1 = f32[8]{0} parameter(0)
+  %dot.4 = f32[8]{0} multiply(f32[8]{0} %Arg_0.1, f32[8]{0} %Arg_0.1), metadata={op_name="jit(f)/encoder/dot_general"}
+  %call = f32[8]{0} call(f32[8]{0} %dot.4), to_apply=%fused_tanh
+  %sine.7 = f32[8]{0} sine(f32[8]{0} %call), metadata={op_name="jit(f)/losses/sin"}
+  %constant.3 = f32[] constant(0)
+  %reduce-window = f32[2]{0} reduce-window(f32[8]{0} %sine.7, f32[] %constant.3), window={size=4 stride=4}, to_apply=%region_add
+  ROOT %reduce.14 = f32[] reduce(f32[2]{0} %reduce-window, f32[] %constant.3), dimensions={0}, to_apply=%region_add, metadata={op_name="jit(f)/losses/reduce_sum"}
+}
+"""
+
+
+def test_hlo_map_resolves_calls_and_operand_chains():
+    m = attrib.hlo_op_components(_HLO)
+    # rule 1: direct metadata
+    assert m["dot.4"] == "encoder"
+    assert m["tanh.5"] == "encoder"
+    assert m["sine.7"] == "losses"
+    assert m["reduce.14"] == "losses"
+    # rule 2: the metadata-less call inherits its called computation
+    assert m["call"] == "encoder"
+    # rule 3: reduce-window inherits its resolved operand (sine.7) — the
+    # constant operand is unresolved, so the vote is still unanimous
+    assert m["reduce-window"] == "losses"
+    # parameters stay out of the map: no rule reaches them, and absence
+    # means unattributed rather than a guess
+    assert "Arg_0.1" not in m
+
+
+def test_attribute_hlo_trace_free_counts():
+    table = attrib.attribute_hlo(_HLO, flops=1000.0, bytes_accessed=64.0)
+    assert table["basis"] == "hlo_op_count"
+    assert table["flops_total"] == 1000.0
+    by_comp = {r["component"]: r for r in table["rows"]}
+    assert by_comp["encoder"]["hlo_ops"] == 3  # dot.4, tanh.5, call
+    assert by_comp["losses"]["hlo_ops"] == 3   # sine.7, reduce-window, reduce.14
+    # parameters/constants/region add land in the honest remainder, last
+    assert table["rows"][-1]["component"] == attrib.UNATTRIBUTED
+    assert 0.0 < table["coverage"] < 1.0
+    assert "no device trace" in table["note"]
+
+
+# -------------------------------------------------------- event attribution
+
+
+def _cpu_event(hlo_op: str, dur: float) -> dict:
+    # XLA:CPU thunk events: only the HLO instruction name, no scope path
+    return {"ph": "X", "pid": 1, "tid": 1, "name": hlo_op, "ts": 0.0,
+            "dur": dur, "args": {"hlo_module": "jit_f", "hlo_op": hlo_op}}
+
+
+def test_attribute_events_buckets_coverage_and_remainder():
+    op_map = attrib.hlo_op_components(_HLO)
+    events = [
+        # metadata events and non-X phases are never op events
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "runsc"}},
+        _cpu_event("dot.4", 400.0),
+        _cpu_event("tanh.5", 300.0),
+        _cpu_event("sine.7", 200.0),
+        # TPU-style: the event itself carries the scope path in its args
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.9", "ts": 0.0,
+         "dur": 50.0, "args": {"tf_op": "jit(f)/decoder/conv", "hlo_op": "x"}},
+        # unknown to both the map and the args: the honest remainder
+        _cpu_event("copy.77", 50.0),
+        # a host-span-style event with no hlo_op arg is not an op event
+        {"ph": "X", "pid": 2, "tid": 1, "name": "step", "ts": 0.0,
+         "dur": 9999.0, "args": {"cat": "train"}},
+    ]
+    table = attrib.attribute_events(events, op_map)
+    assert table["total_ms"] == pytest.approx(1.0)
+    by_comp = {r["component"]: r for r in table["rows"]}
+    assert by_comp["encoder"]["time_ms"] == pytest.approx(0.7)
+    assert by_comp["encoder"]["calls"] == 2
+    assert by_comp["losses"]["time_ms"] == pytest.approx(0.2)
+    assert by_comp["decoder"]["time_ms"] == pytest.approx(0.05)
+    # 950/1000 us attributed: over the bar, remainder row still shown last
+    assert table["coverage"] == pytest.approx(0.95)
+    assert table["covered"] is True
+    assert table["rows"][-1]["component"] == attrib.UNATTRIBUTED
+    assert table["rows"][-1]["time_ms"] == pytest.approx(0.05)
+    # rows sort by time within the attributed set
+    assert [r["component"] for r in table["rows"][:2]] == ["encoder", "losses"]
+
+
+def test_attach_cost_estimates_splits_totals_by_time_share():
+    table = attrib.attribute_events(
+        [_cpu_event("dot.4", 750.0), _cpu_event("sine.7", 250.0)],
+        attrib.hlo_op_components(_HLO),
+    )
+    attrib.attach_cost_estimates(table, flops=1000.0, bytes_accessed=None)
+    by_comp = {r["component"]: r for r in table["rows"]}
+    assert by_comp["encoder"]["flops_est"] == 750
+    assert by_comp["losses"]["flops_est"] == 250
+    assert by_comp["encoder"]["bytes_est"] is None  # absent, not fabricated
+    assert "estimates" in table["cost_note"]
+
+
+def test_attribute_events_below_coverage_bar_is_flagged():
+    table = attrib.attribute_events(
+        [_cpu_event("dot.4", 100.0), _cpu_event("mystery.1", 900.0)],
+        attrib.hlo_op_components(_HLO),
+    )
+    assert table["coverage"] == pytest.approx(0.1)
+    assert table["covered"] is False
+
+
+def test_attribute_profile_dir_round_trip(tmp_path):
+    """The on-disk glue: a gzipped jax.profiler-shaped trace + the HLO
+    dump training writes -> one table; a dir with no op events -> None."""
+    run = tmp_path / "plugins" / "profile" / "2026_01_01"
+    run.mkdir(parents=True)
+    doc = {"traceEvents": [_cpu_event("dot.4", 600.0),
+                           _cpu_event("call", 400.0)]}
+    with gzip.open(run / "runsc.trace.json.gz", "wt") as fh:
+        json.dump(doc, fh)
+    (tmp_path / "train_step_hlo.txt").write_text(_HLO)
+
+    table = attrib.attribute_profile_dir(str(tmp_path))
+    assert table is not None
+    assert table["coverage"] == pytest.approx(1.0)
+    assert {r["component"] for r in table["rows"]} == {"encoder"}
+    assert table["trace"].endswith("runsc.trace.json.gz")
+    assert table["hlo_map_ops"] > 0
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert attrib.attribute_profile_dir(str(empty)) is None
+
+
+# ------------------------------------------- profile_summary lane errors
+
+
+def _write_host_trace(dirpath):
+    from mine_tpu.obs.trace import Tracer
+
+    tracer = Tracer(enabled=True)
+    with tracer.span("step", cat="train"):
+        pass
+    tracer.export(os.path.join(dirpath, "host_spans.trace.json"))
+
+
+def _write_device_trace(dirpath):
+    run = os.path.join(dirpath, "plugins", "profile", "r1")
+    os.makedirs(run)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0 TensorCore"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 0,
+         "dur": 100.0},
+    ]
+    with gzip.open(os.path.join(run, "dev.trace.json.gz"), "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+
+
+@pytest.mark.parametrize("present,missing_word", [
+    ("host", "NO device trace"),
+    ("device", "NO mine_tpu host spans"),
+])
+def test_profile_summary_single_lane_is_a_clear_error(
+    tmp_path, capsys, monkeypatch, present, missing_word
+):
+    """A dir holding one lane kind fails with a message naming the missing
+    half (not a stack trace); --allow-partial restores the old table."""
+    from tools import profile_summary
+
+    (_write_host_trace if present == "host" else _write_device_trace)(
+        str(tmp_path))
+
+    monkeypatch.setattr(sys, "argv", ["profile_summary.py", str(tmp_path)])
+    with pytest.raises(SystemExit) as exc:
+        profile_summary.main()
+    assert exc.value.code == 1
+    err = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert missing_word in err["error"]
+    assert "--allow-partial" in err["error"]
+
+    monkeypatch.setattr(
+        sys, "argv", ["profile_summary.py", str(tmp_path), "--allow-partial"])
+    profile_summary.main()  # no SystemExit: the single lane summarizes
+    header = json.loads(capsys.readouterr().out.splitlines()[0])
+    key = "host_trace" if present == "host" else "trace"
+    assert header[key]
+
+
+def test_profile_summary_embeds_attribution_table(tmp_path, capsys,
+                                                  monkeypatch):
+    _write_host_trace(str(tmp_path))
+    run = tmp_path / "plugins" / "profile" / "r1"
+    run.mkdir(parents=True)
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0 TensorCore"}},
+        _cpu_event("dot.4", 500.0) | {"pid": 7},
+        _cpu_event("copy.77", 100.0) | {"pid": 7},
+    ]
+    with gzip.open(run / "dev.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    (tmp_path / "train_step_hlo.txt").write_text(_HLO)
+
+    monkeypatch.setattr(sys, "argv", ["profile_summary.py", str(tmp_path)])
+    from tools import profile_summary
+
+    profile_summary.main()
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    header = lines[0]
+    assert "attribution" in header
+    assert header["attribution"]["coverage"] == pytest.approx(
+        500.0 / 600.0, abs=1e-3)
+    comp_rows = [ln for ln in lines[1:] if ln.get("lane") == "component"]
+    comps = {r["component"] for r in comp_rows}
+    assert "encoder" in comps and attrib.UNATTRIBUTED in comps
+
+
+# ------------------------------------------------------------- perf ledger
+
+
+def _append_rows(path, values, metric="bench_imgs_per_sec", **extra):
+    for v in values:
+        row = ledger.make_row(
+            metric, v, {"h": 128, "w": 128}, unit="imgs/sec",
+            higher_is_better=True, device="cpu", backend="cpu (forced)",
+            **extra,
+        )
+        ledger.append(str(path), row)
+
+
+def test_ledger_check_round_trip_trips_on_injected_regression(tmp_path):
+    """The acceptance gate: >= 10% imgs/sec drop vs the rolling median
+    exits nonzero; the unmodified rerun passes; thin history is skipped,
+    never failed."""
+    from tools.perf_ledger import main as cli
+
+    path = tmp_path / "perf_ledger.jsonl"
+
+    # one prior row < min_history: skipped, exit 0
+    _append_rows(path, [50.0, 50.0])
+    verdict = ledger.check(str(path))
+    assert verdict["ok"] and not verdict["checked"]
+    assert verdict["skipped"][0]["reason"].startswith("1 prior rows")
+
+    # healthy history + healthy newest: checked, passes (CLI exit 0)
+    _append_rows(path, [51.0, 49.5])
+    assert cli(["check", "--ledger", str(path)]) == 0
+    verdict = ledger.check(str(path))
+    assert verdict["checked"][0]["fields"][0]["regressed"] is False
+
+    # injected 20% regression: CLI exit 1, the verdict names the stream
+    _append_rows(path, [40.0])
+    assert cli(["check", "--ledger", str(path)]) == 1
+    verdict = ledger.check(str(path))
+    assert verdict["regressions"] == 1
+    field = verdict["checked"][0]["fields"][0]
+    assert field["regressed"] and field["regression_delta"] >= 0.10
+    assert field["vs_baseline"] == pytest.approx(0.8, abs=0.01)
+
+    # unmodified rerun (back at baseline speed): passes again
+    _append_rows(path, [50.0])
+    assert cli(["check", "--ledger", str(path)]) == 0
+
+
+def test_ledger_aux_metric_regression_trips_even_when_value_holds(tmp_path):
+    path = tmp_path / "l.jsonl"
+    _append_rows(path, [100.0, 100.0, 100.0], p95_ms=20.0)
+    _append_rows(path, [101.0], p95_ms=30.0)  # p95 +50%, throughput fine
+    verdict = ledger.check(str(path))
+    fields = {f["field"]: f for f in verdict["checked"][0]["fields"]}
+    assert fields["value"]["regressed"] is False
+    assert fields["p95_ms"]["regressed"] is True
+    assert not verdict["ok"]
+
+
+def test_ledger_streams_do_not_cross_contaminate(tmp_path):
+    """Different workload digests / backends are different streams: a fast
+    row from another shape can never mask a regression."""
+    path = tmp_path / "l.jsonl"
+    _append_rows(path, [50.0, 50.0, 40.0])
+    for v in (500.0, 500.0, 500.0):  # same metric, different workload
+        ledger.append(str(path), ledger.make_row(
+            "bench_imgs_per_sec", v, {"h": 256, "w": 256},
+            higher_is_better=True, device="cpu", backend="cpu (forced)"))
+    verdict = ledger.check(str(path))
+    assert len(verdict["checked"]) == 2
+    assert verdict["regressions"] == 1
+    # degraded-vs-forced CPU is ONE hardware class (comparable rows)...
+    assert ledger.backend_class("cpu (degraded: init hang)") == \
+        ledger.backend_class("cpu (forced)")
+    # ...but a real TPU is not
+    assert ledger.backend_class("tpu v4") != ledger.backend_class("cpu")
+
+
+def test_ledger_reader_skips_malformed_lines(tmp_path):
+    path = tmp_path / "l.jsonl"
+    _append_rows(path, [50.0, 50.0, 50.0])
+    with open(path, "a") as fh:
+        fh.write('{"metric": "truncated-by-a-killed-wr\n')
+        fh.write("[1, 2, 3]\n")
+    rows, bad = ledger.read(str(path))
+    assert len(rows) == 3 and bad == 2
+    verdict = ledger.check(str(path))
+    assert verdict["malformed_lines"] == 2 and verdict["ok"]
+
+
+def test_ledger_env_disable_and_never_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv(ledger.LEDGER_ENV, "off")
+    assert ledger.ledger_path() is None
+    assert ledger.append_bench_row(
+        {"metric": "m", "value": 1.0}, {"h": 1}) is None
+    monkeypatch.setenv(ledger.LEDGER_ENV, str(tmp_path / "x.jsonl"))
+    row = ledger.append_bench_row({"metric": "m", "value": 1.0}, {"h": 1})
+    assert row is not None and os.path.exists(tmp_path / "x.jsonl")
+    # unwritable path: the measurement outranks the ledger — None, no raise
+    monkeypatch.setenv(ledger.LEDGER_ENV, "/proc/nope/l.jsonl")
+    assert ledger.append_bench_row(
+        {"metric": "m", "value": 1.0}, {"h": 1}) is None
+
+
+# ----------------------------------------------------------- HBM telemetry
+
+
+class _FakeGauge:
+    def __init__(self):
+        self.values = []
+
+    def set(self, v):
+        self.values.append(v)
+
+
+def test_memlog_samples_gauges_and_counter_events():
+    live, peak = _FakeGauge(), _FakeGauge()
+    stats = [
+        {"device": "d0", "stats": {"bytes_in_use": 100,
+                                   "peak_bytes_in_use": 900}},
+        {"device": "d1", "stats": {"bytes_in_use": 300,
+                                   "peak_bytes_in_use": 700}},
+    ]
+    ml = MemLog(live_gauge=live, peak_gauge=peak, stats_fn=lambda: stats)
+    sample = ml.sample(step=7)
+    # max over devices: the device that OOMs first is the number
+    assert sample["live_bytes"] == 300 and sample["peak_bytes"] == 900
+    assert live.values == [300] and peak.values == [900]
+    assert len(ml) == 1
+
+    last = ml.last()
+    assert last["step"] == 7 and "devices" not in last
+
+    events = ml.counter_events(pid=42)
+    assert events[0]["ph"] == "C" and events[0]["pid"] == 42
+    assert events[0]["args"] == {"live": 300, "peak": 900}
+
+
+def test_memlog_absent_stats_mean_absent_gauges():
+    live = _FakeGauge()
+    ml = MemLog(live_gauge=live,
+                stats_fn=lambda: [{"device": "d0", "stats": None}])
+    assert ml.sample() is None          # CPU backends: no stats, no sample
+    assert live.values == [] and len(ml) == 0
+    assert ml.last() is None
+
+    boom = MemLog(stats_fn=lambda: (_ for _ in ()).throw(RuntimeError()))
+    assert boom.sample() is None        # telemetry must never crash a step

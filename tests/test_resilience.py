@@ -606,43 +606,8 @@ def test_engine_chaos_seam_raises_on_nth_render(admission_app):
 # --------------------------------------- sentinel: in-graph mask + exactness
 
 
-@pytest.fixture(scope="module")
-def tiny_train_setup():
-    """ONE compiled train step shared by the sentinel-mask and the
-    resume-exactness tests (the compile dominates their cost)."""
-    import jax
-
-    from mine_tpu.config import Config
-    from mine_tpu.data import make_synthetic_batch
-    from mine_tpu.training import (
-        build_model,
-        init_state,
-        make_optimizer,
-        make_train_step,
-    )
-
-    cfg = Config().replace(**{
-        "data.name": "synthetic",
-        "data.img_h": 128, "data.img_w": 128,
-        "data.per_gpu_batch_size": 1,
-        "model.num_layers": 18, "model.dtype": "float32",
-        "model.imagenet_pretrained": False,
-        "mpi.num_bins_coarse": 2,
-        "resilience.sentinel_policy": "skip",
-    })
-    model = build_model(cfg)
-    tx = make_optimizer(cfg, steps_per_epoch=100)
-    state0 = init_state(cfg, model, tx, jax.random.PRNGKey(0))
-    step_fn = jax.jit(make_train_step(cfg, model, tx))
-
-    def batch_at(i: int):
-        import jax.numpy as jnp
-
-        b = make_synthetic_batch(1, 128, 128, n_points=16, seed=100 + i)
-        b.pop("src_depth")
-        return {k: jnp.asarray(v) for k, v in b.items()}
-
-    return cfg, state0, step_fn, batch_at
+# tiny_train_setup — the session-scoped compiled-step fixture — moved to
+# tests/conftest.py so every module shares ONE tiny-model compile.
 
 
 

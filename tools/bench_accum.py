@@ -270,11 +270,35 @@ def main() -> None:
     ratio = round(peak_lo / peak_hi, 3) if peak_lo and peak_hi else None
 
     run_ok.set()
+    # perf ledger (obs/ledger.py): the memory-ratio claim joins the same
+    # regression gate as the throughput benches; peak bytes of the deepest
+    # accum point ride along as the gated aux metric
+    ledger_row = None
+    try:
+        from mine_tpu.obs import ledger
+
+        ledger_row = ledger.append_bench_row({
+            "metric": METRIC, "value": ratio, "unit": "x",
+            "higher_is_better": True,
+            "peak_hbm_bytes": points[-1]["peak_bytes"],
+            "step_ms": points[-1].get("step_ms"),
+            "device": jax.devices()[0].device_kind,
+            "backend": backend_note,
+        }, workload={
+            "b": args.b, "h": args.h, "w": args.w,
+            "planes": args.planes, "layers": args.layers,
+            "accum": accums, "steps": args.steps,
+            "micro_ref": bool(args.micro_ref),
+        })
+    except Exception as exc:  # noqa: BLE001 - the number outranks the ledger
+        print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+
     print(json.dumps({
         "metric": METRIC,
         "value": ratio,
         "unit": "x",
         "vs_baseline": None,
+        "ledger_row": ledger_row,
         "b": args.b, "h": args.h, "w": args.w,
         "planes": args.planes, "layers": args.layers,
         "accum": accums,

@@ -259,7 +259,7 @@ def main() -> None:
     app.close()
 
     init_ok.set()
-    print(json.dumps({
+    result = {
         "metric": METRIC,
         "value": round(frames / elapsed, 2),
         "unit": "imgs/sec",
@@ -285,7 +285,39 @@ def main() -> None:
             "micro-batching + jitted render-many); one MPI predicted once, "
             "all renders cache hits"
         ),
-    }))
+    }
+    # live-HBM watermark from the server's own gauge (absent on CPU)
+    peak_hbm = _metric_value(
+        metrics_text, "mine_serve_hbm_peak_bytes", default=None
+    )
+    if peak_hbm:
+        result["peak_hbm_bytes"] = int(peak_hbm)
+
+    # perf ledger (obs/ledger.py): durable row + rolling-baseline check
+    # fodder for tools/perf_ledger.py; p50/p95 ride along (p95 is gated)
+    try:
+        from mine_tpu.obs import ledger
+
+        row = ledger.append_bench_row({
+            "metric": METRIC, "value": result["value"],
+            "unit": "imgs/sec", "higher_is_better": True,
+            "p50_ms": result["render_p50_ms"],
+            "p95_ms": result["render_p95_ms"],
+            "peak_hbm_bytes": result.get("peak_hbm_bytes"),
+            "device": result["device"], "backend": backend_note,
+        }, workload={
+            "h": args.h, "w": args.w, "planes": args.planes,
+            "requests": args.requests, "concurrency": args.concurrency,
+            "poses_per_request": args.poses_per_request,
+            "max_delay_ms": args.max_delay_ms,
+            "trained_workspace": bool(args.workspace),
+        })
+        if row is not None:
+            result["obs"]["ledger_row"] = row
+    except Exception as exc:  # noqa: BLE001 - the number outranks the ledger
+        print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -353,6 +353,31 @@ def main(argv: list[str] | None = None) -> int:
         if args.half in ("serving", "all"):
             verdict["serving"] = serving_half(workdir, args.timeout_s)
             ok = ok and verdict["serving"]["ok"]
+        # final step: the perf regression gate (obs/ledger.py, same verdict
+        # `python tools/perf_ledger.py check` prints standalone) — a drill
+        # that survives its faults but ships a perf regression still fails
+        from mine_tpu.obs import ledger as perf_ledger
+
+        lpath = perf_ledger.ledger_path()
+        if lpath is not None:
+            lv = perf_ledger.check(lpath)
+            verdict["perf_ledger"] = {
+                "ledger": lv["ledger"], "ok": lv["ok"],
+                "rows": lv["rows"], "regressions": lv["regressions"],
+                "streams_checked": len(lv["checked"]),
+                "streams_skipped": len(lv["skipped"]),
+                "failures": [
+                    {**{k: c[k] for k in
+                        ("metric", "device", "backend_class")},
+                     "fields": [f for f in c["fields"] if f["regressed"]]}
+                    for c in lv["checked"]
+                    if any(f["regressed"] for f in c["fields"])
+                ],
+            }
+            ok = ok and lv["ok"]
+        else:
+            verdict["perf_ledger"] = {"ok": True, "note": "ledger disabled "
+                                      "($MINE_TPU_PERF_LEDGER)"}
         verdict["value"] = 1.0 if ok else None
         verdict["ok"] = ok
     except Exception as exc:  # noqa: BLE001 - the verdict IS the output

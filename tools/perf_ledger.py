@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Perf-ledger CLI: the regression gate over perf_ledger.jsonl.
+
+The ledger itself (mine_tpu/obs/ledger.py) is auto-appended by bench.py,
+tools/bench_serve.py, and tools/bench_accum.py. This tool reads it:
+
+  check   compare the newest row of every comparable stream
+          (metric, config digest, device, backend class) against the
+          median of its prior rows; exit 1 when any checked field —
+          value, p95_ms, peak_hbm_bytes — regressed beyond --threshold.
+          Streams with < --min-history prior rows are skipped, not
+          failed. Prints one JSON verdict line (bench.py discipline).
+  show    print the rows (optionally --metric filtered), one per line.
+  append  append a row from --json '{"metric": ..., "value": ...,
+          "workload": {...}}' — for wiring external measurements in.
+
+  python tools/perf_ledger.py check --ledger perf_ledger.jsonl
+  python tools/perf_ledger.py check --threshold 0.05 --window 8
+  python tools/perf_ledger.py show --metric llff_n32_384x512_train_imgs_per_sec_per_chip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mine_tpu.obs import ledger  # noqa: E402 - stdlib-only import
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    pc = sub.add_parser("check", help="gate on the rolling baseline")
+    pc.add_argument("--ledger", default=None,
+                    help="defaults to $MINE_TPU_PERF_LEDGER, else "
+                         f"./{ledger.DEFAULT_LEDGER} — the same resolution "
+                         "the bench writers use")
+    pc.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression beyond this fails (0.10 = 10%%)")
+    pc.add_argument("--window", type=int, default=5,
+                    help="rolling-baseline window (median of last N)")
+    pc.add_argument("--min-history", type=int, default=2,
+                    help="prior comparable rows required before a stream "
+                         "is checked at all")
+
+    ps = sub.add_parser("show", help="print ledger rows")
+    ps.add_argument("--ledger", default=None)
+    ps.add_argument("--metric", default=None)
+
+    pa = sub.add_parser("append", help="append one row")
+    pa.add_argument("--ledger", default=None)
+    pa.add_argument("--json", required=True,
+                    help='row fields, e.g. \'{"metric": "m", "value": 1.0, '
+                         '"workload": {"h": 128}}\'')
+
+    args = ap.parse_args(argv)
+
+    if args.ledger is None:
+        # the same resolution the bench WRITERS use (env wins, "off"
+        # disables) — a gate reading a different file than the writers
+        # append to would silently pass on an empty ledger
+        args.ledger = ledger.ledger_path()
+        if args.ledger is None:
+            print(json.dumps({
+                "ok": True, "note": "perf ledger disabled via "
+                f"${ledger.LEDGER_ENV} — nothing to {args.cmd}",
+            }))
+            return 0
+
+    if args.cmd == "check":
+        verdict = ledger.check(
+            args.ledger, threshold=args.threshold, window=args.window,
+            min_history=args.min_history,
+        )
+        print(json.dumps(verdict))
+        return 0 if verdict["ok"] else 1
+
+    if args.cmd == "show":
+        rows, bad = ledger.read(args.ledger)
+        for row in rows:
+            if args.metric and row.get("metric") != args.metric:
+                continue
+            print(json.dumps(row, sort_keys=True))
+        if bad:
+            print(f"# {bad} malformed line(s) skipped", file=sys.stderr)
+        return 0
+
+    if args.cmd == "append":
+        fields = json.loads(args.json)
+        workload = fields.pop("workload", {})
+        metric = fields.pop("metric")
+        value = fields.pop("value", None)
+        row = ledger.make_row(metric, value, workload, **fields)
+        ledger.append(args.ledger, row)
+        print(json.dumps(row, sort_keys=True))
+        return 0
+
+    return 2  # unreachable (required=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

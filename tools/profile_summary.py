@@ -21,39 +21,46 @@ host-phase name, total ms, % of its lane's time, call count), device rows
 first (TensorCore/SparseCore pids) then host rows, each sorted by total
 duration. The device table is what BASELINE.md's step-composition
 accounting quotes; the host table is what the obs phase breakdown quotes.
+
+When the dir also holds the step HLO dump training writes
+(`train_step_hlo.txt`), the device lane additionally gets the
+PER-COMPONENT attribution table (mine_tpu/obs/attrib.py): encoder /
+decoder / homography_warp / composite / losses / optimizer / zero1_gather
+rows plus the `unattributed` remainder and the >= 90% coverage verdict —
+the table the MFU-climb item optimizes against.
+
+A dir holding only one lane kind (host spans but no device trace, or the
+reverse) is an ERROR by default — the missing half is named explicitly and
+the exit is nonzero, so a CI step can't quietly grade half a profile.
+`--allow-partial` restores the old permissive single-lane table. XLA:CPU
+captures name no device lane; their HLO-op-annotated execution events are
+claimed as the device lane (same discriminator as obs/attrib.py), so an
+honest CPU profile still counts as both lanes.
 """
 
 from __future__ import annotations
 
 import argparse
-import glob
-import gzip
 import json
-import os
 import sys
 from collections import defaultdict
+from pathlib import Path
 
-# must match mine_tpu/obs/trace.py HOST_PROCESS_NAME (kept as a literal so
-# this tool stays importable without mine_tpu on the path)
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from mine_tpu.obs import attrib  # noqa: E402 - stdlib-only import
+
+# must match mine_tpu/obs/trace.py HOST_PROCESS_NAME (this tool imports
+# mine_tpu.obs.attrib — stdlib-only at import time — so the package is on
+# the path anyway; the literal just avoids importing trace.py for one str)
 HOST_LANE_MARKER = "mine_tpu host"
 
 
-def find_traces(root: str) -> list[str]:
-    pats = [
-        os.path.join(root, "**", "*.trace.json.gz"),
-        os.path.join(root, "**", "*.trace.json"),
-    ]
-    out: list[str] = []
-    for p in pats:
-        out.extend(glob.glob(p, recursive=True))
-    return sorted(out)
-
-
-def load_events(path: str) -> list[dict]:
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as fh:
-        data = json.load(fh)
-    return data.get("traceEvents", data if isinstance(data, list) else [])
+# trace-file discovery and Chrome-trace loading are shared with the
+# attribution library — one glob pattern set, one reader, so the two can
+# never disagree about which files exist or how they parse
+find_traces = attrib.find_trace_files
+load_events = attrib.load_trace_events
 
 
 def device_pids(meta_events: list[dict]) -> dict[int, str]:
@@ -81,12 +88,15 @@ def host_pids(meta_events: list[dict]) -> dict[int, str]:
     return names
 
 
-def _op_table(events: list[dict], pids: dict[int, str]):
+def _op_table(events: list[dict], pids: dict[int, str],
+              ops_only: bool = False):
     total_us = 0.0
     by_op: dict[str, list[float]] = defaultdict(list)
     for ev in events:
         if ev.get("ph") != "X" or ev.get("pid") not in pids:
             continue
+        if ops_only and "hlo_op" not in (ev.get("args") or {}):
+            continue  # scheduling/runtime events sharing the XLA:CPU lane
         dur = float(ev.get("dur", 0.0))
         total_us += dur
         by_op[ev.get("name", "?")].append(dur)
@@ -112,13 +122,37 @@ def summarize(trace_dir: str, top: int = 15) -> dict:
     dev_file = host_file = None
     dev_pids: dict[int, str] = {}
     hst_pids: dict[int, str] = {}
+    dev_ops_only = False
     cache: dict[str, list[dict]] = {}
+    unreadable: list[str] = []
     for path in reversed(traces):  # newest (sorted-last) wins per kind
-        events = cache.setdefault(path, load_events(path))
+        if path not in cache:
+            try:
+                cache[path] = load_events(path)
+            except (OSError, ValueError) as exc:
+                # a truncated gz / malformed JSON (killed profiler) must
+                # not stack-trace the whole summary — note it, move on
+                unreadable.append(f"{path}: {exc}")
+                cache[path] = []
+        events = cache[path]
         if dev_file is None:
             pids = device_pids(events)
             if pids:
                 dev_file, dev_pids = path, pids
+            else:
+                # XLA:CPU traces name no device lane (one "/host:CPU"
+                # process) — the op executions are exactly the events
+                # annotated with their HLO op (obs/attrib.py's
+                # discriminator); claim their lane as the device lane
+                pids = {
+                    ev["pid"]: "xla ops (CPU backend, no device lane)"
+                    for ev in events
+                    if ev.get("ph") == "X"
+                    and "hlo_op" in (ev.get("args") or {})
+                }
+                if pids:
+                    dev_file, dev_pids = path, pids
+                    dev_ops_only = True
         if host_file is None:
             pids = host_pids(events)
             if pids:
@@ -138,7 +172,8 @@ def summarize(trace_dir: str, top: int = 15) -> dict:
 
     out: dict = {"rows": []}
     if dev_file is not None:
-        total_us, rows = _op_table(cache[dev_file], dev_pids)
+        total_us, rows = _op_table(cache[dev_file], dev_pids,
+                                   ops_only=dev_ops_only)
         out.update({
             "trace": dev_file,
             "device_lanes": sorted(set(dev_pids.values())),
@@ -150,6 +185,23 @@ def summarize(trace_dir: str, top: int = 15) -> dict:
             "pct": round(100.0 * tot / total_us, 1) if total_us else None,
             "calls": n,
         } for name, tot, n in rows[:top]]
+        # per-component attribution (obs/attrib.py): the device op events
+        # ALREADY PARSED above joined with the named-scope metadata in the
+        # run dir's HLO dump — no second read of multi-hundred-MB traces
+        hlo_text = attrib.find_hlo_text(trace_dir)
+        attribution = attrib.attribute_events(
+            cache[dev_file],
+            attrib.hlo_op_components(hlo_text) if hlo_text else {},
+            None if dev_ops_only else set(dev_pids),
+        )
+        if attribution["rows"]:
+            attribution["trace"] = dev_file
+            out["attribution"] = attribution
+        else:
+            out["attribution_note"] = (
+                "no component attribution: no XLA op events in the device "
+                "trace (host spans and metadata only)"
+            )
     if host_file is not None:
         total_us, rows = _op_table(cache[host_file], hst_pids)
         out.update({
@@ -163,13 +215,43 @@ def summarize(trace_dir: str, top: int = 15) -> dict:
             "pct": round(100.0 * tot / total_us, 1) if total_us else None,
             "calls": n,
         } for name, tot, n in rows[:top]]
+    if unreadable:
+        out["unreadable_traces"] = unreadable
     return out
+
+
+def _lane_error(table: dict, trace_dir: str) -> str | None:
+    """The clear single-lane failure message, or None when both lanes (or
+    neither — the bare-trace fallback) are present."""
+    has_dev = table.get("trace") is not None
+    has_host = table.get("host_trace") is not None
+    if has_host and not has_dev:
+        return (
+            f"profile dir {trace_dir} has host spans "
+            f"({table['host_trace']}) but NO device trace — capture one "
+            "(training: obs.profile_steps > 0; anywhere: "
+            "jax.profiler.start_trace) or pass --allow-partial to "
+            "summarize the host lane alone"
+        )
+    if has_dev and not has_host:
+        return (
+            f"profile dir {trace_dir} has a device trace "
+            f"({table['trace']}) but NO mine_tpu host spans — enable "
+            "obs (obs.enabled: true exports host_spans.trace.json) or "
+            "pass --allow-partial to summarize the device lane alone"
+        )
+    return None
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=15)
+    ap.add_argument(
+        "--allow-partial", action="store_true",
+        help="summarize a dir holding only one lane kind (host spans "
+        "without a device trace, or the reverse) instead of failing",
+    )
     args = ap.parse_args()
 
     try:
@@ -178,10 +260,27 @@ def main() -> None:
         print(json.dumps({"error": str(exc)}))
         sys.exit(1)
 
+    if not args.allow_partial:
+        err = _lane_error(table, args.trace_dir)
+        if err is not None:
+            print(json.dumps({"error": err}))
+            sys.exit(1)
+
     rows = table.pop("rows")
+    attribution = table.pop("attribution", None)
+    if attribution is not None:
+        # header keeps the verdict; the per-component rows print as lines
+        table["attribution"] = {
+            k: attribution[k]
+            for k in ("total_ms", "attributed_ms", "coverage", "covered",
+                      "trace", "hlo_map_ops")
+            if k in attribution
+        }
     print(json.dumps(table))
     for row in rows:
         print(json.dumps(row))
+    for row in (attribution or {}).get("rows", ()):
+        print(json.dumps({"lane": "component", **row}))
 
 
 if __name__ == "__main__":
