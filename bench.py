@@ -208,11 +208,22 @@ def main() -> None:
     _BACKEND_NOTE = backend_note
     on_cpu = backend_note.startswith("cpu")
     if on_cpu:
-        # make JAX_PLATFORMS=cpu stick even against self-registering
-        # accelerator plugins (mine_tpu/utils/platform.py)
-        from mine_tpu.utils.platform import honor_jax_platforms
+        mesh_dims = _bench_mesh_dims()
+        if mesh_dims and mesh_dims != (1, 1, 1):
+            # $BENCH_MESH on the CPU path: the mesh needs that many virtual
+            # devices — the ONE spelling of the device-count flag
+            # (parallel/mesh.py, shared with tests and dryrun_multichip)
+            import math
 
-        honor_jax_platforms()
+            from mine_tpu.parallel.mesh import force_virtual_devices
+
+            force_virtual_devices(math.prod(mesh_dims))
+        else:
+            # make JAX_PLATFORMS=cpu stick even against self-registering
+            # accelerator plugins (mine_tpu/utils/platform.py)
+            from mine_tpu.utils.platform import honor_jax_platforms
+
+            honor_jax_platforms()
 
     import jax
 
@@ -240,6 +251,27 @@ def main() -> None:
 _RESULT_SO_FAR: dict | None = None
 
 
+def _bench_mesh_dims() -> tuple[int, int, int] | None:
+    """$BENCH_MESH="DxFxP" (data x fsdp x plane) opts the bench onto the
+    parallel step over a named mesh — the path that quotes the FSDP
+    per-device param-byte reduction from a live placement. Unset or all-1
+    means the classic single-device jit step."""
+    raw = os.environ.get("BENCH_MESH", "").strip().lower()
+    if not raw:
+        return None
+    parts = tuple(int(p) for p in raw.split("x"))
+    if len(parts) > 3:
+        # reject, never truncate: 2x2x2x2 silently measured as 2x2x2
+        # would be a wrong number wearing the right label
+        raise ValueError(
+            f"BENCH_MESH={raw!r}: at most 3 axes (data x fsdp x plane)"
+        )
+    dims = (parts + (1, 1, 1))[:3]
+    if any(d < 1 for d in dims):
+        raise ValueError(f"BENCH_MESH={raw!r}: axis sizes must be >= 1")
+    return dims
+
+
 def _measure_point(
     batch_size: int,
     profile_dir: str | None = None,
@@ -247,21 +279,28 @@ def _measure_point(
     measure_steps: int = MEASURE_STEPS,
 ) -> dict:
     """One (compile, warm, time) cycle of the full train step at a given
-    per-device batch size. Returns imgs/sec + XLA-cost-analysis MFU fields."""
+    per-device batch size. Returns imgs/sec + XLA-cost-analysis MFU fields
+    plus the sharding instruments: mesh shape and per-device param/opt
+    bytes (parallel/rules.py per_device_bytes — the measurement behind the
+    FSDP "< 1.0x replicated" claim when $BENCH_MESH carves an fsdp axis)."""
     import jax
     import jax.numpy as jnp
 
     from mine_tpu.config import Config
     from mine_tpu.data import make_synthetic_batch
+    from mine_tpu.parallel import rules as rules_mod
     from mine_tpu.training import build_model, init_state, make_optimizer, make_train_step
 
     # perf-experiment knob (BASELINE.md): round decoder up-stage conv widths
     # up to a multiple of the 128-wide MXU lane count. 1 = exact reference
     # widths; measurements with >1 are experiments, not the parity recipe.
     width_multiple = int(os.environ.get("BENCH_WIDTH_MULTIPLE", "1"))
+    mesh_dims = _bench_mesh_dims()
+    on_mesh = mesh_dims is not None and mesh_dims != (1, 1, 1)
+    byte_stats: dict = {}
 
     def build(remat: bool):
-        cfg = Config().replace(**{
+        overrides = {
             "data.name": "llff",
             "data.img_h": 384, "data.img_w": 512,
             "data.per_gpu_batch_size": batch_size,
@@ -270,16 +309,73 @@ def _measure_point(
             "loss.smoothness_grad_ratio": 0.2,
             "model.remat_decoder": remat,
             "model.decoder_width_multiple": width_multiple,
-        })
-        model = build_model(cfg)
-        tx = make_optimizer(cfg, steps_per_epoch=100)
-        state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
-        step = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
-        return state, step
+        }
+        if not on_mesh:
+            cfg = Config().replace(**overrides)
+            model = build_model(cfg)
+            tx = make_optimizer(cfg, steps_per_epoch=100)
+            state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+            dev = jax.devices()[0]
+            byte_stats.update(
+                param_bytes_per_device=rules_mod.per_device_bytes(
+                    state.params, dev),
+                opt_bytes_per_device=rules_mod.per_device_bytes(
+                    state.opt_state, dev),
+            )
+            step = jax.jit(make_train_step(cfg, model, tx), donate_argnums=(0,))
+            return cfg, state, step, 1
+        from mine_tpu.parallel import (
+            data_replica_count, distribute_state, make_mesh,
+            make_parallel_train_step, mesh_shape_str, model_axes,
+        )
 
-    batch_np = make_synthetic_batch(batch_size, 384, 512, n_points=256, seed=0)
-    batch_np.pop("src_depth")
-    batch = {k: jnp.asarray(v) for k, v in batch_np.items()}
+        d, f, p = mesh_dims
+        cfg = Config().replace(**dict(overrides, **{
+            "mesh.data_parallel": d, "mesh.fsdp_parallel": f,
+            "mesh.plane_parallel": p, "parallel.zero1": True,
+        }))
+        mesh = make_mesh(d, p, f)
+        model = build_model(cfg, **model_axes(mesh))
+        tx = make_optimizer(cfg, steps_per_epoch=100)
+        host_state = init_state(cfg, model, tx, jax.random.PRNGKey(0))
+        state = distribute_state(host_state, cfg, mesh)
+        dev = jax.devices()[0]
+        # the FSDP/ZeRO byte instruments: live per-device residency of the
+        # PLACED state vs the full (replicated) tree — quoted in the JSON
+        # and the perf ledger row so the reduction claim is auditable
+        byte_stats.update(
+            mesh_shape=mesh_shape_str(mesh),
+            param_bytes_per_device=rules_mod.per_device_bytes(
+                state.params, dev),
+            param_bytes_replicated=rules_mod.per_device_bytes(
+                host_state.params),
+            opt_bytes_per_device=rules_mod.per_device_bytes(
+                state.opt_state, dev),
+            opt_bytes_replicated=rules_mod.per_device_bytes(
+                host_state.opt_state),
+        )
+        step = make_parallel_train_step(cfg, model, tx, mesh, state=state)
+        return cfg, state, step, data_replica_count(mesh)
+
+    batch: dict = {}
+
+    def stage_batch(replicas: int):
+        """per-device batch_size on every batch replica: the global batch
+        is batch_size x replicas, sharded per the rule table's batch row."""
+        batch_np = make_synthetic_batch(
+            batch_size * replicas, 384, 512, n_points=256, seed=0
+        )
+        batch_np.pop("src_depth")
+        if on_mesh:
+            from mine_tpu.config import Config as _Config
+            from mine_tpu.parallel import make_mesh, shard_batch
+
+            d, f, p = mesh_dims
+            return shard_batch(
+                make_mesh(d, p, f), batch_np,
+                rules_mod.partition_rules(_Config()),
+            )
+        return {k: jnp.asarray(v) for k, v in batch_np.items()}
 
     def force(state, loss_dict) -> float:
         """Ground-truth completion barrier: host-fetch values that depend on
@@ -301,7 +397,8 @@ def _measure_point(
         return compiled, state, loss_dict
 
     remat_used = False
-    state, step = build(remat=False)
+    _cfg, state, step, replicas = build(remat=False)
+    batch = stage_batch(replicas)
     try:
         compiled, state, loss_dict = compile_and_warm(state, step)
     except Exception as e:  # noqa: BLE001 - HBM OOM => retry with remat
@@ -310,7 +407,8 @@ def _measure_point(
         print(f"# OOM at B={batch_size} without remat, retrying with "
               f"remat_decoder ({e})", file=sys.stderr)
         remat_used = True
-        state, step = build(remat=True)
+        _cfg, state, step, replicas = build(remat=True)
+        batch = stage_batch(replicas)
         compiled, state, loss_dict = compile_and_warm(state, step)
 
     if profile_dir:
@@ -331,7 +429,7 @@ def _measure_point(
         force(state, loss_dict)
     elapsed = time.perf_counter() - t0
 
-    imgs_per_sec = batch_size * measure_steps / elapsed
+    imgs_per_sec = batch_size * replicas * measure_steps / elapsed
     flops_per_step = executable_flops(compiled)
     device = jax.devices()[0]
     peak = chip_peak_flops(device.device_kind)
@@ -354,6 +452,10 @@ def _measure_point(
         "remat": remat_used,
         "width_multiple": width_multiple,
         "device": device.device_kind,
+        # sharding instruments (parallel/rules.py): mesh_shape only when a
+        # non-trivial mesh ran (ledger streams key on it); the byte fields
+        # are the live per-device residency the FSDP claim quotes
+        **byte_stats,
     }
 
 
@@ -387,6 +489,11 @@ def _ledger_update(result: dict, workload: dict) -> None:
             "mfu": result.get("mfu"), "step_ms": result.get("step_ms"),
             "peak_hbm_bytes": peak_hbm, "device": result.get("device"),
             "backend": result.get("backend"),
+            # comparability-key member (obs/ledger.py stream_key): absent on
+            # trivial meshes so pre-mesh baseline streams carry over
+            "mesh_shape": result.get("mesh_shape"),
+            "param_bytes_per_device": result.get("param_bytes_per_device"),
+            "opt_bytes_per_device": result.get("opt_bytes_per_device"),
         }, workload)
         if row is None:
             return  # ledger disabled via $MINE_TPU_PERF_LEDGER
@@ -432,6 +539,10 @@ def _run(backend_note: str = "", on_cpu: bool = False) -> None:
         "width_multiple": primary["width_multiple"],
         "device": primary["device"],
         "backend": backend_note,
+        **{k: primary[k] for k in (
+            "mesh_shape", "param_bytes_per_device", "param_bytes_replicated",
+            "opt_bytes_per_device", "opt_bytes_replicated",
+        ) if k in primary},
         "obs": _obs_snapshot(),
         "note": (
             "vs_baseline awaits a reference denominator on comparable "

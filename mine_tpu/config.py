@@ -212,28 +212,46 @@ class ResilienceConfig:
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Device mesh layout (TPU-native; no reference analog — the reference's
-    only axis is NCCL data-parallel process count, train.py:66)."""
+    """Device mesh layout: the named (data, fsdp, plane) axes
+    (parallel/mesh.py; no reference analog — the reference's only axis is
+    NCCL data-parallel process count, train.py:66)."""
 
-    data_parallel: int = -1  # -1: all available devices
+    data_parallel: int = -1  # -1: all devices not claimed by the others
+    # FSDP axis: batches shard over it LIKE data (data x fsdp is the
+    # batch-replica product), and the partition-rule table additionally
+    # shards params (and their Adam moments) over it — the first layout
+    # where per-device param bytes drop below full replication. The axis
+    # size IS the FSDP knob: 1 = off.
+    fsdp_parallel: int = 1
     plane_parallel: int = 1  # S-axis sharding (SURVEY.md §5.7 stretch)
 
 
 @dataclass(frozen=True)
 class ParallelConfig:
     """Parallelism strategy knobs beyond mesh LAYOUT (which stays in
-    mesh.*): how state is distributed over that mesh."""
+    mesh.*): how state is distributed over that mesh. Since the named-mesh
+    refactor the layouts live in ONE declarative regex -> PartitionSpec
+    table (parallel/rules.py); the knobs here are aliases/overrides that
+    resolve to rule rows."""
 
-    # ZeRO-1 optimizer-state sharding (parallel/zero1.py): Adam moments
-    # partitioned over the data axis (each leaf split along its largest
-    # dividing dimension, small leaves replicated), updates computed on the
-    # local shard and all-gathered into the replicated params. Per-device
-    # optimizer-state bytes drop ~1/data_parallel; checkpoints stay
-    # layout-independent (gather-on-save, training/checkpoint.py).
+    # DEPRECATED ALIAS (kept, fully functional): ZeRO-1 optimizer-state
+    # sharding. Resolves to the table's Adam-moment rows — moments shard
+    # over (fsdp x data) when true (the classic ZeRO-1 layout on an
+    # fsdp-less mesh: over `data` alone), over fsdp only (following their
+    # param's FSDP shard) when false. Updates are computed on the local
+    # moment shard and all-gathered back to each param's own layout;
+    # checkpoints stay layout-independent (gather-on-save,
+    # training/checkpoint.py).
     zero1: bool = False
-    # leaves with fewer elements stay replicated (sharding a bias buys
-    # nothing and costs an all_gather launch)
+    # leaves with fewer elements stay replicated under ANY rule row
+    # (sharding a bias buys nothing and costs an all_gather launch)
     zero1_min_size: int = 1024
+    # extra partition-rule rows, PREPENDED to the default table (first
+    # match wins): "pattern = axes" strings, axes a comma-joined mesh-axis
+    # list, `replicated`, or `axes @ dim` to pin the split dimension —
+    # e.g. "^params/decoder/ = replicated" to exempt the decoder from
+    # FSDP. See parallel/rules.py for the default table.
+    rules: tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)

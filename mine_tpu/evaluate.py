@@ -39,11 +39,12 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
 
     from mine_tpu.losses import load_lpips_params
     from mine_tpu.parallel import (
+        data_replica_count,
+        distribute_state,
         init_multihost,
         make_mesh,
         make_parallel_eval_step,
         model_axes,
-        replicate_state,
     )
     from mine_tpu.train import build_dataset
     from mine_tpu.training import build_model, init_state, make_optimizer
@@ -57,7 +58,10 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
     cfg = ckpt.load_paired_config(args.checkpoint, overrides=args.extra_config)
     sidecar = ckpt.local_sidecar_dir(args.checkpoint)
 
-    mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
+    mesh = make_mesh(
+        cfg.mesh.data_parallel, cfg.mesh.plane_parallel,
+        cfg.mesh.fsdp_parallel,
+    )
     model = build_model(cfg, **model_axes(mesh))
     tx = make_optimizer(cfg, steps_per_epoch=1)
     template = init_state(
@@ -69,12 +73,16 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
         raise FileNotFoundError(
             f"no checkpoint under {args.checkpoint}/checkpoints"
         )
-    state = replicate_state(state, mesh)
+    # table-driven placement: replicated, FSDP param shards, or ZeRO-1
+    # moments, whatever the config's rule rows resolve to on this mesh
+    state = distribute_state(state, cfg, mesh)
 
-    global_batch = cfg.data.per_gpu_batch_size * mesh.shape["data"]
+    global_batch = cfg.data.per_gpu_batch_size * data_replica_count(mesh)
     val_ds = build_dataset(cfg, "val", global_batch)
     lpips_params = load_lpips_params(cfg.training.lpips_weights_path)
-    eval_step = make_parallel_eval_step(cfg, model, mesh, lpips_params)
+    eval_step = make_parallel_eval_step(
+        cfg, model, mesh, lpips_params, state=state
+    )
 
     logger = make_logger(sidecar)
     writer = MetricWriter(os.path.join(sidecar, "eval"))

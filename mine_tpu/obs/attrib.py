@@ -4,8 +4,9 @@ The MFU-climb roadmap item stalls on a question tools/profile_summary.py
 cannot answer: raw HLO op rows ("fusion.123", "dot.4") say nothing about
 WHICH model component — encoder, decoder, warp, composite, losses,
 optimizer — owns the device time. The components are now annotated with
-`jax.named_scope` throughout models/, ops/, training/step.py and
-parallel/zero1.py, so every XLA op's metadata carries a scope path like
+`jax.named_scope` throughout models/, ops/ and training/step.py (the
+sharded-update gathers carry zero1_gather, the FSDP weight gather
+fsdp_gather), so every XLA op's metadata carries a scope path like
 
     jit(train_step)/transpose(jvp(...))/losses/composite/reduce_sum
 
@@ -51,6 +52,7 @@ COMPONENT_PATTERNS: tuple[tuple[str, re.Pattern], ...] = tuple(
     (name, re.compile(pat))
     for name, pat in (
         ("zero1_gather", r"^zero1_gather$"),
+        ("fsdp_gather", r"^fsdp_gather$"),
         ("optimizer", r"^optimizer$"),
         ("losses", r"^losses$"),
         ("homography_warp", r"^homography_warp$"),

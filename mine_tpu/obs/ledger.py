@@ -11,8 +11,8 @@ appends one row carrying
   device + backend_class (what hardware), and the perf vitals —
   mfu, step_ms, peak_hbm_bytes, p50_ms/p95_ms where they exist.
 
-`check` compares each (metric, config_digest, device, backend_class)
-stream's NEWEST row against the median of its prior rows (the rolling
+`check` compares each (metric, config_digest, device, backend_class,
+mesh_shape) stream's NEWEST row against the median of its prior rows (the rolling
 baseline) and flags a regression when the newest value moves beyond
 `threshold` in the bad direction — the gate every later perf PR quotes
 (`python tools/perf_ledger.py check`). Fewer than `min_history` prior
@@ -144,11 +144,18 @@ def read(path: str) -> tuple[list[dict], int]:
 
 
 def stream_key(row: dict) -> tuple:
+    """(metric, workload digest, device, backend class, mesh shape): two
+    rows are comparable only when ALL agree — `check` must never grade a
+    (4,2)-mesh run against a single-chip baseline stream. `mesh_shape` is
+    the 'DxFxP' string (parallel/mesh.py mesh_shape_str); writers OMIT it
+    for trivial single-device runs, so pre-mesh history keys identically
+    to new single-device rows and baselines carry over."""
     return (
         row.get("metric"),
         row.get("config_digest"),
         row.get("device"),
         row.get("backend_class", backend_class(row.get("backend"))),
+        row.get("mesh_shape"),
     )
 
 

@@ -1,23 +1,35 @@
-"""SPMD parallelism: mesh construction, data-parallel step wrappers,
-plane-axis sharded compositing (reference: NCCL DDP, SURVEY.md §2.3-2.4)."""
+"""SPMD parallelism: the (data, fsdp, plane) named mesh, the declarative
+partition-rule table (regex -> PartitionSpec, parallel/rules.py) that is
+the single source of every param/grad/opt-state/batch sharding, the
+table-driven train/eval step wrappers, and plane-axis sharded compositing
+(reference: NCCL DDP, SURVEY.md §2.3-2.4)."""
 
 from mine_tpu.parallel.mesh import (
+    AXIS_NAMES,
+    BATCH_AXES,
     DATA_AXIS,
+    FSDP_AXIS,
     PLANE_AXIS,
+    batch_sharding,
+    data_replica_count,
+    force_virtual_devices,
     init_multihost,
     make_mesh,
-    batch_sharding,
+    mesh_shape_str,
     shard_batch,
 )
+from mine_tpu.parallel import rules
 from mine_tpu.parallel.data_parallel import (
-    make_parallel_train_step,
+    batch_axis_name,
+    distribute_state,
+    fsdp_enabled,
     make_parallel_eval_step,
+    make_parallel_train_step,
     model_axes,
     replicate_state,
-    distribute_state,
+    sharding_active,
     zero1_enabled,
 )
-from mine_tpu.parallel import zero1
 from mine_tpu.parallel.plane_sharding import (
     plane_compositor,
     sharded_alpha_composition,

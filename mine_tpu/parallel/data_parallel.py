@@ -1,11 +1,16 @@
-"""Data-parallel train/eval step wrappers over the device mesh.
+"""Parallel train/eval step wrappers over the (data, fsdp, plane) mesh.
 
 The reference's distributed story (DDP gradient allreduce + SyncBN +
-DistributedSampler, SURVEY.md §2.3) becomes: `shard_map` the train step over
-the mesh with the batch axis sharded on `data`, the loss averaged across
-replicas before differentiation and BN stats synced inside the step
-(mine_tpu/training/step.py), state replicated. One jit; XLA
-lowers the collectives onto ICI/DCN.
+DistributedSampler, SURVEY.md §2.3) becomes: `shard_map` the train step
+over the named mesh with the batch axis sharded over data x fsdp, the loss
+averaged across replicas before differentiation and BN stats synced inside
+the step (mine_tpu/training/step.py), and the state laid out by the ONE
+declarative partition-rule table (parallel/rules.py) — params sharded over
+`fsdp` (gathered in-step, FSDP), Adam moments over fsdp x data (the ZeRO-1
+rows), everything else replicated. The same table supplies the shard_map
+in/out_specs, the explicit `jax.jit` in_shardings/out_shardings, and the
+live `distribute_state` placement, so the compiled layout and the resident
+layout cannot diverge. One jit; XLA lowers the collectives onto ICI/DCN.
 """
 
 from __future__ import annotations
@@ -21,26 +26,41 @@ from mine_tpu.utils.jax_compat import shard_map
 from mine_tpu.config import Config
 from mine_tpu.models import MPINetwork
 from mine_tpu.ops import compositor_from_config
-from mine_tpu.parallel.mesh import DATA_AXIS, PLANE_AXIS
-from mine_tpu.parallel import zero1
+from mine_tpu.parallel import rules as rules_mod
+from mine_tpu.parallel.mesh import (
+    BATCH_AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    PLANE_AXIS,
+    data_replica_count,
+)
 from mine_tpu.parallel.plane_sharding import plane_compositor
 from mine_tpu.training.step import make_eval_step, make_train_step
 from mine_tpu.training.state import TrainState
 
-_REPL = P()  # replicated
-_BATCH = P(DATA_AXIS)  # shard axis 0 over data, replicate over plane
+_REPL = P()  # replicated (pytree-prefix spec)
 
 
 def model_axes(mesh: Mesh) -> dict:
     """build_model kwargs for a model living on this mesh: BN syncs over
-    `data` always; under plane sharding the decoder's post-conditioning BNs
-    additionally pool over `plane` (its effective batch B*S splits across
-    both axes — models/decoder.py)."""
+    the batch-replica axes — `data` always, plus `fsdp` when that axis is
+    wider than 1 (batches shard over both); under plane sharding the
+    decoder's post-conditioning BNs additionally pool over `plane` (its
+    effective batch B*S splits across the axes — models/decoder.py)."""
     n_plane = mesh.shape.get(PLANE_AXIS, 1)
     return {
-        "axis_name": DATA_AXIS,
+        "axis_name": batch_axis_name(mesh),
         "plane_axis": PLANE_AXIS if n_plane > 1 else None,
     }
+
+
+def batch_axis_name(mesh: Mesh) -> str | tuple[str, ...]:
+    """The named axis (or axes) one logical batch spans: `data`, or
+    ("data","fsdp") when the fsdp axis is non-trivial. Collectives with
+    DDP-replica semantics (loss pmean, BN sync, eval psum) use this."""
+    if mesh.shape.get(FSDP_AXIS, 1) > 1:
+        return BATCH_AXES
+    return DATA_AXIS
 
 
 def _plane_args(cfg: Config, mesh: Mesh) -> dict:
@@ -76,25 +96,54 @@ def _plane_args(cfg: Config, mesh: Mesh) -> dict:
 
 
 def zero1_enabled(cfg: Config, mesh: Mesh) -> bool:
-    """Whether ZeRO-1 actually runs: the knob is on AND there is something
-    to shard over — on a 1-wide data axis the "shard" is the whole state
-    and the layout degrades to replicated. The one definition of the
-    degrade rule: distribute_state, the step builder, and the Trainer's
-    opt_layout.json sidecar all consult it, so what the sidecar records is
-    by construction what was placed."""
-    return bool(cfg.parallel.zero1) and mesh.shape[DATA_AXIS] > 1
+    """Whether the ZeRO-1 moment rows actually shard anything: the (alias)
+    knob is on AND the batch-replica product is wider than 1 — on a 1-wide
+    product the "shard" is the whole state and the rule rows resolve to
+    replicated (parallel/rules.py resolve_placement drops size-1 axes)."""
+    return bool(cfg.parallel.zero1) and data_replica_count(mesh) > 1
 
 
-def _state_specs(cfg: Config, mesh: Mesh, state: TrainState | None):
-    """shard_map PartitionSpecs for the TrainState: a bare P() (replicated,
-    prefix-matched over the whole pytree) unless ZeRO-1 is on — then
-    zero1.state_specs, the SAME layout rule distribute_state places by, so
-    the compiled step and the live placement cannot diverge."""
-    if state is None or not zero1_enabled(cfg, mesh):
-        return _REPL
-    return zero1.state_specs(
-        state, mesh.shape[DATA_AXIS], cfg.parallel.zero1_min_size
+def fsdp_enabled(mesh: Mesh) -> bool:
+    """FSDP is the fsdp mesh axis being non-trivial — the axis size IS the
+    knob (mesh.fsdp_parallel)."""
+    return mesh.shape.get(FSDP_AXIS, 1) > 1
+
+
+def sharding_active(cfg: Config, mesh: Mesh) -> bool:
+    """Whether ANY state leaf leaves full replication under the table —
+    the predicate deciding when the step builders need a state template."""
+    return fsdp_enabled(mesh) or zero1_enabled(cfg, mesh)
+
+
+def _state_layout(cfg: Config, mesh: Mesh, state: TrainState | None):
+    """(state spec tree, param placements, update placements) from the
+    partition-rule table — or the replicated defaults when nothing shards.
+    THE single derivation the compiled step, the jit shardings, and the
+    live placement all consume."""
+    if state is None or not sharding_active(cfg, mesh):
+        return _REPL, None, None
+    table = rules_mod.partition_rules(cfg)
+    min_size = cfg.parallel.zero1_min_size
+    placements = rules_mod.state_placements(table, state, mesh, min_size)
+    specs = rules_mod.tree_specs(placements)
+    return specs, placements.params, rules_mod.update_placements(
+        table, state.params, mesh, min_size
     )
+
+
+def _jit_shardings(mesh: Mesh, state_specs, batch_spec):
+    """Explicit NamedShardings for jax.jit from the same spec trees the
+    shard_map constrains — stated twice on purpose: jit enforces the
+    layout at the executable boundary (a mis-placed input is resharded or
+    rejected there, not silently re-laid-out inside)."""
+    as_named = lambda s: NamedSharding(mesh, s)  # noqa: E731
+    if isinstance(state_specs, P):
+        state_sh = as_named(state_specs)
+    else:
+        state_sh = jax.tree.map(
+            as_named, state_specs, is_leaf=lambda x: isinstance(x, P)
+        )
+    return state_sh, as_named(batch_spec), as_named(P())
 
 
 def make_parallel_train_step(
@@ -104,14 +153,17 @@ def make_parallel_train_step(
     mesh: Mesh,
     state: TrainState | None = None,
 ) -> Callable:
-    """jit(shard_map(train_step)): state replicated, batch sharded over
-    `data` and replicated over `plane`; with a plane axis of size > 1, each
-    device runs the decoder + renderer on its S_local plane chunk and the
-    compositing reductions cross the plane axis (plane_sharding.py).
+    """jit(shard_map(train_step)) with table-derived shardings: batch
+    sharded over data x fsdp and replicated over plane; params sharded over
+    `fsdp` per the rule table (all-gathered at step start — FSDP); Adam
+    moments sharded over fsdp x data (the ZeRO-1 rows); with a plane axis
+    of size > 1, each device runs the decoder + renderer on its S_local
+    plane chunk and the compositing reductions cross the plane axis
+    (plane_sharding.py).
 
-    The model must have been built with axis_name=model_axis_name(mesh)
+    The model must have been built with axis_name=model_axes(mesh)
     (build_model) so BN stats sync; the step pmeans the loss pre-grad over
-    `data` and logged losses post-grad (step.py).
+    the batch-replica axes and logged losses post-grad (step.py).
 
     BOTH arguments are donated: the state is consumed and returned every
     step, and the batch's device buffers are dead the moment the step has
@@ -119,37 +171,42 @@ def make_parallel_train_step(
     (training/loop.py staged_batches), so holding the old one alive only
     padded peak HBM by one full batch.
 
-    With `parallel.zero1` (and a data axis wider than 1), pass the
-    replicated-or-host `state` template: the optimizer-state leaves get
-    data-axis PartitionSpecs (parallel/zero1.py) in both in_ and out_specs,
-    and the step computes updates on the local moment shard + all_gather
-    (training/step.py apply_update). `distribute_state` must have placed
-    the live state with the matching layout.
+    Whenever any rule row shards state (fsdp axis > 1, or `parallel.zero1`
+    with a non-trivial batch-replica product), pass the replicated-or-host
+    `state` template: the leaf PartitionSpecs are shape-dependent and
+    `distribute_state` must have placed the live state with the matching
+    layout (both derive from `rules.state_placements`, so they agree by
+    construction).
     """
-    use_zero1 = zero1_enabled(cfg, mesh)
-    if use_zero1 and state is None:
+    specs, param_pl, update_pl = _state_layout(cfg, mesh, state)
+    if sharding_active(cfg, mesh) and state is None:
         raise ValueError(
-            "parallel.zero1 needs the state template to derive the "
-            "opt-state partition specs: make_parallel_train_step(..., "
-            "state=state)"
+            "the partition-rule table shards state on this mesh "
+            f"(fsdp={mesh.shape.get(FSDP_AXIS, 1)}, "
+            f"zero1={cfg.parallel.zero1}) and the leaf specs are "
+            "shape-dependent: pass the state template — "
+            "make_parallel_train_step(..., state=state)"
         )
-    dims = None
-    if use_zero1:
-        dims = zero1.tree_partition_dims(
-            state.params, mesh.shape[DATA_AXIS], cfg.parallel.zero1_min_size
-        )
+    table = rules_mod.partition_rules(cfg)
+    batch_spec = rules_mod.batch_spec(table)
     step = make_train_step(
-        cfg, model, tx, axis_name=DATA_AXIS, zero1_dims=dims,
+        cfg, model, tx, axis_name=batch_axis_name(mesh),
+        param_placements=param_pl, update_placements=update_pl,
         **_plane_args(cfg, mesh),
     )
-    specs = _state_specs(cfg, mesh, state)
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(specs, _BATCH),
+        in_specs=(specs, batch_spec),
         out_specs=(specs, _REPL),
     )
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    state_sh, batch_sh, repl_sh = _jit_shardings(mesh, specs, batch_spec)
+    return jax.jit(
+        sharded,
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, repl_sh),
+        donate_argnums=(0, 1),
+    )
 
 
 def make_parallel_eval_step(
@@ -159,27 +216,47 @@ def make_parallel_eval_step(
     lpips_params: dict | None = None,
     state: TrainState | None = None,
 ) -> Callable:
-    """jit(shard_map(eval_step)): losses pmean'd to replicated; per-replica
+    """jit(shard_map(eval_step)): losses psum'd to replicated; per-replica
     visualizations stay batch-sharded (gather only what gets logged).
 
     The eval body reads only params/batch_stats, but it is handed the whole
-    TrainState — under `parallel.zero1`, pass the same `state` template as
-    the train step so the opt-state leaves keep their data-axis specs
-    through shard_map. A replicated in_spec would make jit all-gather the
-    sharded Adam moments onto every device on each eval call, spiking HBM
-    right back to the replicated footprint the sharding exists to remove;
-    with the matching specs the unused shards just flow through."""
+    TrainState — under any sharded layout, pass the same `state` template
+    as the train step so the leaves keep their table-derived specs through
+    shard_map. A replicated in_spec would make jit all-gather the sharded
+    Adam moments onto every device on each eval call, spiking HBM right
+    back to the replicated footprint the sharding exists to remove; with
+    the matching specs the unused shards just flow through (the eval body
+    gathers the fsdp param shards itself, exactly like the train step)."""
+    if sharding_active(cfg, mesh) and state is None:
+        # same guard as the train builder: a replicated eval spec on a
+        # sharded mesh would silently re-inflate every sharded leaf per call
+        raise ValueError(
+            "the partition-rule table shards state on this mesh "
+            f"(fsdp={mesh.shape.get(FSDP_AXIS, 1)}, "
+            f"zero1={cfg.parallel.zero1}) and the leaf specs are "
+            "shape-dependent: pass the state template — "
+            "make_parallel_eval_step(..., state=state)"
+        )
+    specs, param_pl, _ = _state_layout(cfg, mesh, state)
+    table = rules_mod.partition_rules(cfg)
+    batch_spec = rules_mod.batch_spec(table)
     step = make_eval_step(
-        cfg, model, lpips_params=lpips_params, axis_name=DATA_AXIS,
+        cfg, model, lpips_params=lpips_params,
+        axis_name=batch_axis_name(mesh), param_placements=param_pl,
         **_plane_args(cfg, mesh),
     )
     sharded = shard_map(
         step,
         mesh=mesh,
-        in_specs=(_state_specs(cfg, mesh, state), _BATCH, _REPL),
-        out_specs=(_REPL, _BATCH),
+        in_specs=(specs, batch_spec, _REPL),
+        out_specs=(_REPL, batch_spec),
     )
-    return jax.jit(sharded)
+    state_sh, batch_sh, repl_sh = _jit_shardings(mesh, specs, batch_spec)
+    return jax.jit(
+        sharded,
+        in_shardings=(state_sh, batch_sh, repl_sh),
+        out_shardings=(repl_sh, batch_sh),
+    )
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
@@ -189,13 +266,16 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 
 def distribute_state(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
-    """Place a (host or replicated) TrainState per the configured layout:
-    fully replicated, or — under `parallel.zero1` — params/BN replicated
-    with the optimizer state sharded over `data` (parallel/zero1.py).
+    """Place a (host or replicated) TrainState per the partition-rule
+    table: fully replicated, FSDP param shards over `fsdp`, and/or Adam
+    moments over fsdp x data (parallel/rules.py).
 
-    The single entry point for every placement in the training loop
-    (initial, warm start, rollback restore), so a restored checkpoint —
-    always saved gathered/layout-free — lands back in the live layout."""
-    if not zero1_enabled(cfg, mesh):
+    The single placement entry point for every placement in the training
+    loop (initial, warm start, rollback restore), so a restored checkpoint
+    — always saved gathered/layout-free — lands back in the live layout."""
+    if not sharding_active(cfg, mesh):
         return replicate_state(state, mesh)
-    return zero1.place_state(state, mesh, cfg.parallel.zero1_min_size)
+    return rules_mod.place_state(
+        rules_mod.partition_rules(cfg), state, mesh,
+        cfg.parallel.zero1_min_size,
+    )

@@ -1,10 +1,15 @@
 """Device mesh construction and multi-host bootstrap.
 
 Replaces the reference's NCCL process-group bootstrap (train.py:61-69,
-start_training.sh:75-83) with single-program SPMD over a
-`jax.sharding.Mesh`. Two axes:
+start_training.sh:75-83) with single-program SPMD over a named
+`jax.sharding.Mesh` with three axes (the MaxText-style factorization,
+SNIPPETS.md [1]):
 
   data  — batch sharding (the reference's only axis: DDP data parallel)
+  fsdp  — parameter sharding: batches ALSO shard over it (so data x fsdp
+          is the batch-replica product), while params/grad-moments split
+          over it per the partition-rule table (parallel/rules.py) — the
+          axis that first drops per-device param bytes below replication
   plane — MPI plane (S) sharding, this model's sequence-parallel analog
           (SURVEY.md §5.7): activations scale with B*S through decoder and
           renderer, so S is the axis long-context pressure lives on.
@@ -20,7 +25,44 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
 PLANE_AXIS = "plane"
+AXIS_NAMES = (DATA_AXIS, FSDP_AXIS, PLANE_AXIS)
+# THE spelling of XLA's virtual-host-device flag, re-exported for mesh
+# consumers (force_virtual_devices below, subprocess envs in
+# tools/chaos_drill.py). The definition lives in utils/platform.py — the
+# stdlib-weight module every pre-backend CLI guard already imports — so
+# neither layer imports the other for a string.
+from mine_tpu.utils.platform import VIRTUAL_DEVICE_FLAG  # noqa: E402,F401
+# the batch-replica product: batches shard their leading dim over BOTH —
+# fsdp contributes batch parallelism like data, it only additionally
+# shards the params (parallel/rules.py)
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+def force_virtual_devices(
+    n_devices: int,
+    compilation_cache: bool = False,
+    fast_compile: bool = False,
+) -> None:
+    """THE virtual-device setup every mesh consumer shares — tests
+    (tests/conftest.py), the driver's `dryrun_multichip`, the slow
+    mesh-equivalence subprocesses, and the benches' forced-CPU paths all
+    come through here, so the `--xla_force_host_platform_device_count`
+    spelling (and the ordering rules around it) cannot drift between them.
+
+    Must run before any JAX backend touch; raises RuntimeError otherwise.
+    The implementation core lives in `mine_tpu.utils.platform`
+    (`force_cpu_devices`) because the CLI platform guard shares it without
+    importing the parallel package.
+    """
+    from mine_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(
+        n_devices,
+        compilation_cache=compilation_cache,
+        fast_compile=fast_compile,
+    )
 
 
 def init_multihost(coordinator: str | None = None) -> None:
@@ -73,31 +115,68 @@ def init_multihost(coordinator: str | None = None) -> None:
         raise
 
 
-def make_mesh(data_parallel: int = -1, plane_parallel: int = 1) -> Mesh:
-    """Build the (data, plane) mesh. data_parallel=-1 takes every device not
-    claimed by plane_parallel."""
+def make_mesh(
+    data_parallel: int = -1,
+    plane_parallel: int = 1,
+    fsdp_parallel: int = 1,
+) -> Mesh:
+    """Build the (data, fsdp, plane) mesh. data_parallel=-1 takes every
+    device not claimed by fsdp_parallel x plane_parallel.
+
+    Keyword order keeps the historical (data, plane) call sites valid;
+    fsdp_parallel is the new axis (mesh.fsdp_parallel)."""
     devices = np.asarray(jax.devices())
     n = devices.size
-    if plane_parallel < 1 or n % plane_parallel:
-        raise ValueError(f"plane_parallel={plane_parallel} must divide {n} devices")
-    if data_parallel == -1:
-        data_parallel = n // plane_parallel
-    if data_parallel * plane_parallel != n:
+    for name, size in (("plane_parallel", plane_parallel),
+                       ("fsdp_parallel", fsdp_parallel)):
+        if size < 1 or n % size:
+            raise ValueError(f"{name}={size} must divide {n} devices")
+    claimed = plane_parallel * fsdp_parallel
+    if n % claimed:
         raise ValueError(
-            f"mesh {data_parallel}x{plane_parallel} != {n} available devices"
+            f"fsdp_parallel={fsdp_parallel} x plane_parallel="
+            f"{plane_parallel} must divide {n} devices"
         )
-    return Mesh(devices.reshape(data_parallel, plane_parallel), (DATA_AXIS, PLANE_AXIS))
+    if data_parallel == -1:
+        data_parallel = n // claimed
+    if data_parallel * claimed != n:
+        raise ValueError(
+            f"mesh {data_parallel}x{fsdp_parallel}x{plane_parallel} != {n} "
+            "available devices"
+        )
+    return Mesh(
+        devices.reshape(data_parallel, fsdp_parallel, plane_parallel),
+        AXIS_NAMES,
+    )
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for host batches: batch axis over `data`, replicated over
-    `plane`."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+def data_replica_count(mesh: Mesh) -> int:
+    """How many batch shards the mesh holds: the data x fsdp product (the
+    quantity every 'global batch' computation multiplies by)."""
+    return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
 
 
-def shard_batch(mesh: Mesh, batch: dict) -> dict:
-    """device_put a host batch with the batch axis sharded over `data`
+def mesh_shape_str(mesh: Mesh) -> str:
+    """Canonical 'DxFxP' label (perf-ledger comparability key, bench obs)."""
+    return "x".join(str(mesh.shape[a]) for a in AXIS_NAMES)
+
+
+def batch_sharding(mesh: Mesh, rules: tuple | None = None) -> NamedSharding:
+    """Sharding for host batches, read off the rule table's `^batch/` row
+    (parallel/rules.py): batch axis over data x fsdp, replicated over
+    plane."""
+    from mine_tpu.parallel import rules as rules_mod
+
+    if rules is None:
+        spec = P(BATCH_AXES)
+    else:
+        spec = rules_mod.batch_spec(rules)
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh: Mesh, batch: dict, rules: tuple | None = None) -> dict:
+    """device_put a host batch with the batch axis sharded over data x fsdp
     (replaces the reference's per-process DistributedSampler slicing,
     train.py:88 — here one logical batch spans the mesh)."""
-    sharding = batch_sharding(mesh)
+    sharding = batch_sharding(mesh, rules)
     return jax.device_put(batch, sharding)

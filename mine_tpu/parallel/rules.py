@@ -1,0 +1,492 @@
+"""Declarative partition rules: ONE regex -> PartitionSpec table drives
+every sharding in the system.
+
+Before this module the parallel layer stated its layouts in three places:
+`zero1.state_specs` (ZeRO-1 optimizer sharding), the `_BATCH = P("data")`
+constant in data_parallel.py, and the ad-hoc plane spec construction in the
+plane tests. The MaxText-style pattern (SNIPPETS.md [1] named
+`("data","fsdp","tensor")` mesh axes, [3] `match_partition_rules`
+regex -> PartitionSpec trees) replaces all of it: a single ordered rule
+table maps leaf PATHS (params, optimizer state, batch stats, batch
+tensors) to mesh-axis assignments, and everything — the compiled step's
+`in_specs`/`out_specs`, the `jax.jit` `in_shardings`/`out_shardings`, the
+live `device_put` placement, the checkpoint re-placement, and the serving
+engine's placement — derives from it.
+
+Rule semantics
+--------------
+A rule is `(pattern, axes, dim)`:
+
+  pattern  regex, `re.search`ed against the '/'-joined leaf path
+           (e.g. `params/decoder/Conv_3/kernel`,
+           `opt_state/inner_states/backbone/inner_state/1/mu/.../kernel`,
+           `batch/src_img`). FIRST MATCH WINS — order the table from
+           specific to general. A leaf no rule matches is a hard error:
+           silence here would mean a silently replicated (or silently
+           mis-sharded) tensor.
+  axes     tuple of mesh-axis names to shard ONE dimension over
+           (major-first), or None to replicate.
+  dim      which dimension: None applies the shape rule ZeRO-1 proved
+           (largest dimension divisible by the axis product; leaves under
+           `min_size` elements replicate), an int pins the dimension
+           (batch rows pin 0) and non-divisibility is an error.
+
+Anchored resolution keeps params and their optimizer moments consistent
+WITHOUT tree pairing: the shape rule is a pure function of the leaf shape,
+so a `(3,3,16,2048)` kernel and its same-shaped Adam moments always agree
+on the split dimension. Multi-axis assignments resolve left-to-right —
+`("fsdp","data")` first anchors the dimension with the `fsdp` axis size
+alone (the SAME computation the param's `("fsdp",)` row performs for the
+same shape), then extends over the trailing axes while the dimension keeps
+dividing. Size-1 mesh axes drop out before resolution, which is exactly
+how the old knobs degrade: with `mesh.fsdp_parallel: 1`, the moment row
+`("fsdp","data")` resolves to plain ZeRO-1 over `data`, and with
+`parallel.zero1: false` on a 1-wide fsdp axis everything replicates — the
+pre-mesh layouts are special cases of the table.
+
+The default table (`partition_rules(cfg)`):
+
+  ^(step|rng)$                -> replicated
+  ^params/.*kernel$           -> ("fsdp",)          # FSDP: conv kernels
+  ^params/                    -> replicated          # biases, BN affine
+  ^batch_stats/               -> replicated
+  ^opt_state/.*\\b(mu|nu)/     -> ("fsdp","data")    # the ZeRO-1 rows
+                                  (("fsdp",) when parallel.zero1 is off)
+  ^opt_state/                 -> replicated          # counts, empty states
+  ^batch/                     -> ("data","fsdp") at dim 0
+
+`parallel.rules` config rows ("pattern = axes" strings) PREPEND to the
+default table, so an override wins by first-match precedence.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mine_tpu.parallel.mesh import AXIS_NAMES, DATA_AXIS, FSDP_AXIS
+
+__all__ = [
+    "Rule", "Placement", "REPLICATED", "partition_rules",
+    "match_partition_rules", "state_placements", "state_specs",
+    "state_shardings", "place_state", "batch_spec", "partition_dim",
+    "resolve_placement", "update_placements", "placement_bytes",
+    "per_device_bytes", "tree_specs", "parse_rule",
+]
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One row of the table: leaf-path regex -> mesh-axis assignment."""
+
+    pattern: str
+    axes: tuple[str, ...] | None  # None = replicate
+    dim: int | None = None  # None = shape rule; int = pinned dimension
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A resolved rule: which dimension of a leaf splits over which mesh
+    axes (major-first). `dim == -1` (the `REPLICATED` singleton) means the
+    leaf lives whole on every device."""
+
+    dim: int
+    axes: tuple[str, ...] = ()
+
+    @property
+    def replicated(self) -> bool:
+        return self.dim < 0 or not self.axes
+
+    def spec(self) -> P:
+        if self.replicated:
+            return P()
+        entry = self.axes if len(self.axes) > 1 else self.axes[0]
+        return P(*([None] * self.dim + [entry]))
+
+    def shards(self, mesh_shape: dict[str, int]) -> int:
+        if self.replicated:
+            return 1
+        return math.prod(mesh_shape[a] for a in self.axes)
+
+
+REPLICATED = Placement(dim=-1, axes=())
+
+
+# ---------------------------------------------------------------- the table
+
+
+def parse_rule(row: str) -> Rule:
+    """One `parallel.rules` config row: `"pattern = axes"` where axes is a
+    comma-joined mesh-axis list, `replicated`, or `axes @ dim` to pin the
+    dimension — e.g. `"^params/decoder/ = fsdp"`,
+    `"^opt_state/.*mu/ = fsdp,data"`, `"^batch/ = data,fsdp @ 0"`."""
+    if "=" not in row:
+        raise ValueError(
+            f"parallel.rules row {row!r} is not 'pattern = axes'"
+        )
+    pattern, _, rhs = row.partition("=")
+    rhs = rhs.strip()
+    dim: int | None = None
+    if "@" in rhs:
+        rhs, _, d = rhs.partition("@")
+        dim = int(d.strip())
+    rhs = rhs.strip()
+    if rhs.lower() in ("", "replicated", "none"):
+        axes = None
+    else:
+        axes = tuple(a.strip() for a in rhs.split(",") if a.strip())
+        unknown = set(axes) - set(AXIS_NAMES)
+        if unknown:
+            raise ValueError(
+                f"parallel.rules row {row!r} names unknown mesh axes "
+                f"{sorted(unknown)} (mesh axes: {AXIS_NAMES})"
+            )
+    return Rule(pattern.strip(), axes, dim)
+
+
+def partition_rules(cfg: Any) -> tuple[Rule, ...]:
+    """THE table. `parallel.rules` override rows prepend (first match
+    wins); the retired `parallel.zero1` knob survives as the alias that
+    selects the Adam-moment row's axes — `("fsdp","data")` (ZeRO-1 over
+    the whole batch-replica product) when on, `("fsdp",)` (moments merely
+    follow their FSDP param shard) when off."""
+    user = tuple(parse_rule(r) for r in getattr(cfg.parallel, "rules", ()))
+    opt_axes = (FSDP_AXIS, DATA_AXIS) if cfg.parallel.zero1 else (FSDP_AXIS,)
+    return user + (
+        Rule(r"^(step|rng)$", None),
+        Rule(r"^params/.*kernel$", (FSDP_AXIS,)),
+        Rule(r"^params/", None),
+        Rule(r"^batch_stats/", None),
+        Rule(r"^opt_state/.*\b(mu|nu)/", opt_axes),
+        Rule(r"^opt_state/", None),
+        Rule(r"^batch/", (DATA_AXIS, FSDP_AXIS), dim=0),
+    )
+
+
+# ----------------------------------------------------------- path utilities
+
+
+def _key_name(entry: Any) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def leaf_path(path: tuple, prefix: str = "") -> str:
+    """'/'-joined leaf path, e.g. `params/decoder/Conv_0/kernel`."""
+    parts = [p for p in (prefix.strip("/"),) if p]
+    parts += [_key_name(e) for e in path]
+    return "/".join(parts)
+
+
+def _match(rules: Iterable[Rule], path: str) -> Rule:
+    for rule in rules:
+        if re.search(rule.pattern, path):
+            return rule
+    raise ValueError(
+        f"no partition rule matches leaf {path!r} — every leaf must be "
+        "matched explicitly (add a row to parallel.rules or the default "
+        "table in parallel/rules.py)"
+    )
+
+
+# ------------------------------------------------------------- resolution
+
+
+def partition_dim(shape: tuple[int, ...], n_shards: int, min_size: int) -> int:
+    """Which dimension of a leaf to split over n_shards, or -1 (replicate).
+
+    The shape rule ZeRO-1 proved (pure function of the SHAPE, so a param,
+    its gradient, and its Adam moments always agree): dimensions are tried
+    largest-first — a (3,3,16,2048) conv kernel splits its 2048, not the 3
+    — and the first one divisible by n_shards wins. Leaves under min_size
+    elements, scalars, and leaves with no dividing dimension replicate.
+    """
+    if not shape or n_shards <= 1:
+        return -1
+    if math.prod(shape) < min_size:
+        return -1
+    for d in sorted(range(len(shape)), key=lambda i: shape[i], reverse=True):
+        if shape[d] % n_shards == 0 and shape[d] >= n_shards:
+            return d
+    return -1
+
+
+def resolve_placement(
+    shape: tuple[int, ...],
+    axes: tuple[str, ...] | None,
+    mesh_shape: dict[str, int],
+    min_size: int,
+    dim: int | None = None,
+    path: str = "?",
+) -> Placement:
+    """Rule RHS -> Placement for a concrete leaf shape.
+
+    Size-1 mesh axes drop out first (sharding over them is replication —
+    this is how `("fsdp","data")` degrades to plain ZeRO-1 on an fsdp-less
+    mesh). Pinned dims (batch rows) must divide exactly. Shape-rule dims
+    resolve ANCHORED left-to-right: the first surviving axis picks the
+    dimension by `partition_dim` with its size alone, then trailing axes
+    extend the split while the dimension keeps dividing — so a moment row
+    `("fsdp","data")` lands on the same dimension its param's `("fsdp",)`
+    row picked for the same shape.
+    """
+    if not axes:
+        return REPLICATED
+    live = tuple(a for a in axes if mesh_shape.get(a, 1) > 1)
+    if not live:
+        return REPLICATED
+    if dim is not None:
+        n = math.prod(mesh_shape[a] for a in live)
+        if dim >= len(shape) or shape[dim] % n:
+            raise ValueError(
+                f"{path}: dim {dim} of shape {tuple(shape)} does not divide "
+                f"over axes {live} (sizes "
+                f"{[mesh_shape[a] for a in live]})"
+            )
+        return Placement(dim, live)
+    d = partition_dim(shape, mesh_shape[live[0]], min_size)
+    if d < 0:
+        return resolve_placement(
+            shape, live[1:], mesh_shape, min_size, path=path
+        )
+    keep = 1
+    n = mesh_shape[live[0]]
+    for a in live[1:]:
+        if shape[d] % (n * mesh_shape[a]):
+            break
+        n *= mesh_shape[a]
+        keep += 1
+    return Placement(d, live[:keep])
+
+
+# ---------------------------------------------------------------- tree APIs
+
+
+def match_partition_rules(
+    rules: Iterable[Rule],
+    tree: Any,
+    mesh_shape: dict[str, int],
+    min_size: int,
+    prefix: str = "",
+) -> Any:
+    """Placement per leaf: first-matching rule, resolved against the leaf
+    shape. Unmatched leaves raise (never a silent default)."""
+    rules = tuple(rules)
+
+    def one(path, leaf):
+        p = leaf_path(path, prefix)
+        rule = _match(rules, p)
+        return resolve_placement(
+            np.shape(leaf), rule.axes, mesh_shape, min_size,
+            dim=rule.dim, path=p,
+        )
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def tree_specs(placements: Any) -> Any:
+    """Placement tree -> bare PartitionSpec tree (shard_map in/out_specs)."""
+    return jax.tree.map(
+        lambda pl: pl.spec(), placements,
+        is_leaf=lambda x: isinstance(x, Placement),
+    )
+
+
+def _mesh_shape(mesh: Mesh | dict[str, int]) -> dict[str, int]:
+    return dict(mesh.shape) if isinstance(mesh, Mesh) else dict(mesh)
+
+
+def state_placements(
+    rules: Iterable[Rule], state: Any, mesh: Mesh | dict[str, int],
+    min_size: int,
+) -> Any:
+    """Placement tree for a TrainState: each field matched under its path
+    prefix (`params/...`, `opt_state/...`, `batch_stats/...`, `step`,
+    `rng`). The one derivation both the compiled step's specs and the live
+    `device_put` placement share, so they cannot diverge."""
+    shape = _mesh_shape(mesh)
+    rules = tuple(rules)
+    fields = {
+        name: match_partition_rules(
+            rules, getattr(state, name), shape, min_size, prefix=name
+        )
+        for name in ("params", "batch_stats", "opt_state")
+    }
+    step_pl = match_partition_rules(rules, state.step, shape, min_size,
+                                    prefix="step")
+    rng_pl = match_partition_rules(rules, state.rng, shape, min_size,
+                                   prefix="rng")
+    placed = state.replace(step=step_pl, rng=rng_pl, **fields)
+    _validate_update_layout(rules, state, placed, shape, min_size)
+    return placed
+
+
+def update_placements(
+    rules: Iterable[Rule], params: Any, mesh: Mesh | dict[str, int],
+    min_size: int,
+) -> Any:
+    """The optimizer-shard granularity, PARAM-structured: for each param
+    leaf, the placement its Adam moments get from the table. Matched via a
+    synthetic `opt_state/mu/<param path>` probe path — moment rows must
+    therefore key on `\\b(mu|nu)/` plus the param-path suffix (the default
+    table does), not on exact optax chain indices. The in-step sharded
+    optimizer update slices grads/params by THIS tree, runs `tx.update` on
+    the shard, and gathers the update back to each param's own layout."""
+    shape = _mesh_shape(mesh)
+    return match_partition_rules(
+        tuple(rules), params, shape, min_size, prefix="opt_state/mu"
+    )
+
+
+_MOMENT_RE = re.compile(r"\b(mu|nu)/")
+
+
+def _validate_update_layout(rules, state, placed, mesh_shape, min_size):
+    """The sharded optimizer update requires every param's moment placement
+    to EXTEND its own (same dim, axes prefix — or a replicated param with
+    any moment layout), AND the resident moment leaves to resolve exactly
+    as their `opt_state/mu/<param path>` probe twins do (the in-step
+    update slices by the probe-derived tree while the resident opt state
+    was placed by the real paths). The anchored shape rule + default table
+    guarantee both; a user override row keyed on real optax chain paths
+    (or on the probe form alone) can break either, and must fail here with
+    names, not inside a compiled step with a shape error."""
+    rules = tuple(rules)
+    for (path, leaf), pl in zip(
+        jax.tree_util.tree_leaves_with_path(state.opt_state),
+        jax.tree.leaves(
+            placed.opt_state, is_leaf=lambda x: isinstance(x, Placement)
+        ),
+    ):
+        p = leaf_path(path, "opt_state")
+        last = None
+        for m in _MOMENT_RE.finditer(p):
+            last = m
+        if last is None:
+            continue  # not a moment leaf (counts, empty states)
+        probe = "opt_state/mu/" + p[last.end():]
+        rule = _match(rules, probe)
+        probe_pl = resolve_placement(
+            np.shape(leaf), rule.axes, mesh_shape, min_size,
+            dim=rule.dim, path=probe,
+        )
+        if probe_pl != pl:
+            raise ValueError(
+                f"{p}: resident moment placement {pl} != the placement its "
+                f"probe path {probe!r} resolves to ({probe_pl}) — a "
+                "parallel.rules row matches one form but not the other; "
+                "key moment rows on `\\b(mu|nu)/` plus the param-path "
+                "suffix so both resolve identically"
+            )
+    upd = update_placements(rules, state.params, mesh_shape, min_size)
+
+    def check(path, ppl, upl):
+        if upl.replicated:
+            if not ppl.replicated:
+                raise ValueError(
+                    f"params/{leaf_path(path)}: param sharded {ppl} but its "
+                    "optimizer moments replicate — the update cannot be "
+                    "assembled; align the params/ and opt_state/ rule rows"
+                )
+            return ppl
+        if ppl.replicated:
+            return ppl
+        if ppl.dim != upl.dim or upl.axes[: len(ppl.axes)] != ppl.axes:
+            raise ValueError(
+                f"params/{leaf_path(path)}: param placement {ppl} is not a "
+                f"prefix of its moment placement {upl} — the rule rows for "
+                "params/ and opt_state/ moments must agree on the split"
+            )
+        return ppl
+
+    jax.tree_util.tree_map_with_path(
+        check, placed.params, upd,
+        is_leaf=lambda x: isinstance(x, Placement),
+    )
+
+
+def state_specs(rules, state, mesh, min_size) -> Any:
+    return tree_specs(state_placements(rules, state, mesh, min_size))
+
+
+def state_shardings(rules, state, mesh: Mesh, min_size) -> Any:
+    """NamedSharding pytree for device_put / jit in_shardings."""
+    specs = state_specs(rules, state, mesh, min_size)
+    # PartitionSpec is a tuple subclass, i.e. itself a pytree — stop the
+    # traversal at spec leaves or tree.map would recurse into them
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def place_state(rules, state: Any, mesh: Mesh, min_size: int) -> Any:
+    """device_put a (host or replicated) TrainState into the table's
+    layout. The inverse needs no helper: `jax.device_get` of the placed
+    state returns full global arrays — what keeps checkpoints layout-free
+    (training/checkpoint.py gather-on-save)."""
+    return jax.device_put(state, state_shardings(rules, state, mesh, min_size))
+
+
+def batch_spec(rules: Iterable[Rule]) -> P:
+    """The batch sharding the table prescribes, as a pytree-prefix spec
+    (every batch tensor shards its leading dim the same way). Read off the
+    `^batch/` row directly — batch leaves are placeholder-shaped here, the
+    actual divisibility check happens at `shard_batch`/trace time."""
+    rule = _match(tuple(rules), "batch/src_img")
+    if rule.axes is None:
+        return P()
+    if (rule.dim or 0) != 0:
+        raise ValueError(
+            f"the batch rule must pin dim 0 (got dim={rule.dim}); batches "
+            "shard their leading (example) axis only"
+        )
+    entry = rule.axes if len(rule.axes) > 1 else rule.axes[0]
+    return P(entry)
+
+
+# ------------------------------------------------------------- measurement
+
+
+def placement_bytes(shapes: Any, placements: Any,
+                    mesh: Mesh | dict[str, int]) -> int:
+    """Analytic per-device bytes of a tree under a placement tree — shapes
+    may be real arrays or `jax.eval_shape` ShapeDtypeStructs, so the
+    tier-1 tests can pin the FSDP byte reduction without materializing a
+    model."""
+    shape = _mesh_shape(mesh)
+    total = 0
+    for leaf, pl in zip(
+        jax.tree.leaves(shapes),
+        jax.tree.leaves(
+            placements, is_leaf=lambda x: isinstance(x, Placement)
+        ),
+    ):
+        nbytes = math.prod(np.shape(leaf) or (1,)) * np.dtype(leaf.dtype).itemsize
+        total += nbytes // pl.shards(shape)
+    return total
+
+
+def per_device_bytes(tree: Any, device: Any | None = None) -> int:
+    """Bytes of `tree` resident on ONE device — the measurement behind
+    every per-device-bytes claim (bench.py obs snapshot,
+    tools/bench_accum.py, tests). Sharded leaves count only the local
+    shard; replicated leaves their full size; host arrays one replica."""
+    if device is None:
+        device = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None:
+            total += sum(s.data.nbytes for s in shards if s.device == device)
+        else:
+            total += np.asarray(leaf).nbytes
+    return total

@@ -205,10 +205,24 @@ class RenderEngine:
         # (training/checkpoint.py load_for_serving) arrives as host numpy
         # leaves, and numpy inputs to a compiled executable re-transfer on
         # every call — the whole params tree per predict, the exact cost a
-        # long-lived engine exists to amortize away
-        self.variables = jax.device_put(
-            {"params": params, "batch_stats": batch_stats}
-        )
+        # long-lived engine exists to amortize away. The placement flows
+        # through the SAME partition-rule table training uses
+        # (parallel/rules.py) so a future multi-device serving mesh changes
+        # serving and training layouts from one table instead of two code
+        # paths; on today's single-device (1,1,1) mesh every row resolves
+        # to replicated, and the placement is an OPTIMIZATION — an exotic
+        # checkpoint whose variables a table row fails to match falls back
+        # to the plain replicated device_put instead of failing startup.
+        variables = {"params": params, "batch_stats": batch_stats}
+        try:
+            shardings = self._placement_shardings(cfg, params, batch_stats)
+            self.variables = jax.device_put(variables, shardings)
+        except ValueError as exc:
+            import sys
+
+            print(f"# serving placement fell back to plain device_put "
+                  f"(partition-rule table: {exc})", file=sys.stderr)
+            self.variables = jax.device_put(variables)
         self.checkpoint_step = int(checkpoint_step)
         self.metrics = metrics
         # request-scoped spans (X-Request-Id): predict/render dispatches
@@ -232,6 +246,38 @@ class RenderEngine:
         self._buckets_lock = threading.Lock()
 
     # -- internals -----------------------------------------------------------
+
+    def _placement_shardings(self, cfg, params, batch_stats):
+        """NamedShardings for the resident variables from the partition-rule
+        table, on a single-device (1,1,1) mesh — the serving twin of
+        training's `distribute_state`. Render/predict executables consume
+        the variables wherever this puts them."""
+        import numpy as np_
+
+        import jax
+        from jax.sharding import Mesh
+
+        from mine_tpu.parallel import AXIS_NAMES, rules as rules_mod
+
+        mesh = Mesh(
+            np_.asarray(jax.devices()[:1]).reshape(1, 1, 1), AXIS_NAMES
+        )
+        table = rules_mod.partition_rules(cfg)
+        min_size = cfg.parallel.zero1_min_size
+        specs = {
+            name: rules_mod.tree_specs(rules_mod.match_partition_rules(
+                table, tree, dict(mesh.shape), min_size, prefix=name
+            ))
+            for name, tree in (
+                ("params", params), ("batch_stats", batch_stats),
+            )
+        }
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
 
     def _donate(self, argnums: tuple[int, ...]) -> dict:
         import jax

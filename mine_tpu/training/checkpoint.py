@@ -129,7 +129,7 @@ def wait_until_finished(manager: ocp.CheckpointManager) -> None:
     manager.wait_until_finished()
 
 
-# -- optimizer-state layout sidecar (parallel/zero1.py) ------------------------
+# -- state layout sidecar (partition-rule table, parallel/rules.py) -----------
 #
 # Checkpoints themselves are LAYOUT-INDEPENDENT: every save path goes
 # through jax.device_get, which gathers sharded leaves into full global

@@ -43,15 +43,20 @@ from mine_tpu.obs.cost import (
 )
 from mine_tpu.parallel import (
     DATA_AXIS,
+    FSDP_AXIS,
+    data_replica_count,
     distribute_state,
+    fsdp_enabled,
     init_multihost,
     make_mesh,
     make_parallel_eval_step,
     make_parallel_train_step,
+    mesh_shape_str,
     model_axes,
     shard_batch,
     zero1_enabled,
 )
+from mine_tpu.parallel import rules as rules_mod
 from mine_tpu.resilience import (
     PreemptedError,
     PreemptionGuard,
@@ -85,6 +90,7 @@ def staged_batches(
     epoch_iter: Iterable[dict],
     retries: int = 0,
     on_retry: Callable[[int, BaseException], None] | None = None,
+    rules: tuple | None = None,
 ) -> Iterable[dict]:
     """Two-stage pipeline overlap (SURVEY.md §7.4.7; the reference builds
     every batch synchronously in the step loop, nerf_dataset.py:199-236):
@@ -100,8 +106,12 @@ def staged_batches(
         epoch_iter, max(num_workers - 2, 0),
         retries=retries, on_retry=on_retry, fault_seam="loader_raise",
     )
+    # `rules` is the config's partition-rule table: a `parallel.rules`
+    # batch-row override must place host batches exactly where the compiled
+    # step's table-derived in_shardings expect them (None = default table)
     return prefetch(
-        host, min(num_workers, 2), transfer=lambda b: shard_batch(mesh, b)
+        host, min(num_workers, 2),
+        transfer=lambda b: shard_batch(mesh, b, rules),
     )
 
 
@@ -225,7 +235,14 @@ class Trainer:
         self._compiled_train_step = None
         self._peak_flops = None
         self._peak_hbm = None
-        self.mesh = make_mesh(cfg.mesh.data_parallel, cfg.mesh.plane_parallel)
+        self.mesh = make_mesh(
+            cfg.mesh.data_parallel, cfg.mesh.plane_parallel,
+            cfg.mesh.fsdp_parallel,
+        )
+        # the config's partition-rule table, resolved once: host batches
+        # must land where the compiled step's table-derived in_shardings
+        # expect them even under a parallel.rules batch-row override
+        self._rules = rules_mod.partition_rules(cfg)
         self.logger = make_logger(self.local_dir)
         self.writer = MetricWriter(self.local_dir)
         self.sentinel = TrainingSentinel(
@@ -237,7 +254,10 @@ class Trainer:
         # batch into accum_steps micro-batches inside the step; it never
         # multiplies the loader batch, so throughput (imgs/sec) and the
         # effective-batch gauge both stay per-update quantities.
-        self.global_batch = cfg.data.per_gpu_batch_size * self.mesh.shape[DATA_AXIS]
+        # batches shard over the data x fsdp product (parallel/mesh.py)
+        self.global_batch = (
+            cfg.data.per_gpu_batch_size * data_replica_count(self.mesh)
+        )
         self.accum_steps = max(int(cfg.training.accum_steps), 1)
         if cfg.data.per_gpu_batch_size % self.accum_steps:
             raise ValueError(
@@ -260,6 +280,9 @@ class Trainer:
             ckpt.record_opt_layout(self.workspace, {
                 "zero1": self.zero1,
                 "data_parallel": self.mesh.shape[DATA_AXIS],
+                "fsdp_parallel": self.mesh.shape[FSDP_AXIS],
+                "mesh_shape": mesh_shape_str(self.mesh),
+                "fsdp": fsdp_enabled(self.mesh),
                 "zero1_min_size": cfg.parallel.zero1_min_size,
             })
             if self.local_dir != workspace:
@@ -274,6 +297,7 @@ class Trainer:
             self.mesh, self.cfg.data.num_workers, epoch_iter,
             retries=self.cfg.data.loader_retries,
             on_retry=self._on_loader_retry,
+            rules=self._rules,
         )
 
     def _on_loader_retry(self, attempt: int, exc: BaseException) -> None:
@@ -341,10 +365,11 @@ class Trainer:
                 self.logger.info(
                     "warm-started from %s @ step %d", warm_path, warm_step
                 )
-        # single placement entry point: replicated, or — under
-        # parallel.zero1 — opt state sharded over `data` (parallel/zero1.py).
-        # Restores always pass through here, so a gathered (layout-free)
-        # checkpoint lands back in the live layout.
+        # single placement entry point: whatever layout the partition-rule
+        # table resolves on this mesh — replicated, FSDP param shards,
+        # ZeRO-1 moment shards (parallel/rules.py). Restores always pass
+        # through here, so a gathered (layout-free) checkpoint lands back
+        # in the live layout.
         state = distribute_state(state, cfg, self.mesh)
 
         lpips_params = load_lpips_params(cfg.training.lpips_weights_path)
@@ -879,7 +904,10 @@ def run_evaluation(
     key = jax.random.PRNGKey(cfg.training.seed + 17)
     viz = None
     n_examples = 0
-    for i, batch in enumerate(staged_batches(mesh, cfg.data.num_workers, val_ds.epoch(0))):
+    for i, batch in enumerate(staged_batches(
+        mesh, cfg.data.num_workers, val_ds.epoch(0),
+        rules=rules_mod.partition_rules(cfg),
+    )):
         loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
         # metric values are weighted means over GENUINE examples only
         # (wrap-padded slots carry eval_weight 0, training/step.py

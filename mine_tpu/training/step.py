@@ -47,6 +47,100 @@ from mine_tpu.models import MPINetwork, predict_mpi_coarse_to_fine
 from mine_tpu.training.state import TrainState
 from mine_tpu.utils.jax_compat import axis_size, has_vma
 
+
+def _combined_axis_index(axes: tuple[str, ...]) -> Array:
+    """Row-major index over a tuple of named mesh axes (major-first) — the
+    chunk index a P((a1, a2)) partition assigns this device."""
+    idx = jnp.zeros((), jnp.int32)
+    for ax in axes:
+        idx = idx * axis_size(ax) + lax.axis_index(ax)
+    return idx
+
+
+def _gather_placed(tree: Any, placements: Any) -> Any:
+    """all_gather every sharded leaf back to its full shape — the FSDP
+    weight gather. `placements` is the param-structured Placement tree from
+    parallel/rules.py (duck-typed here: .replicated/.dim/.axes — step.py
+    must not import the parallel package at module scope). Gathers run
+    minor-axis-first so chunks reassemble in P-order; replicated leaves
+    pass through untouched, so the whole call is a no-op on an unsharded
+    layout."""
+    if placements is None:
+        return tree
+
+    def gather(x, pl):
+        if pl.replicated:
+            return x
+        for ax in reversed(pl.axes):
+            x = lax.all_gather(x, ax, axis=pl.dim, tiled=True)
+        return x
+
+    return jax.tree.map(gather, tree, placements)
+
+
+def _slice_placed(tree: Any, placements: Any) -> Any:
+    """The inverse of _gather_placed: each device's chunk of every sharded
+    leaf (full replicated-value trees in, local shards out)."""
+
+    def slc(x, pl):
+        if pl.replicated:
+            return x
+        n = 1
+        for ax in pl.axes:
+            n *= axis_size(ax)
+        chunk = x.shape[pl.dim] // n
+        start = _combined_axis_index(pl.axes) * chunk
+        return lax.dynamic_slice_in_dim(x, start, chunk, axis=pl.dim)
+
+    return jax.tree.map(slc, tree, placements)
+
+
+def sharded_update(
+    tx: optax.GradientTransformation,
+    grads: Any,
+    opt_state_local: Any,
+    params_full: Any,
+    update_placements: Any,
+    param_placements: Any,
+) -> tuple[Any, Any]:
+    """The table-driven sharded optimizer step (subsumes the old
+    parallel/zero1.py `shard_update`), called INSIDE shard_map with fully
+    reduced (replicated-in-value) grads, the gathered full params, and the
+    LOCAL shard of the optimizer state.
+
+    Each device slices its optimizer-shard chunk of every partitioned
+    grad/param leaf (`update_placements` — the moment rows of the rule
+    table, resolved per param shape), runs tx.update on the shard (exact —
+    the chain is elementwise per leaf), and all_gathers each update chunk
+    back to ITS PARAM'S layout: over the trailing axes the param is not
+    itself sharded on. Under plain ZeRO-1 (param replicated, moments over
+    data) that is the classic full-update gather; under FSDP + ZeRO-1
+    (param over fsdp, moments over fsdp x data) only the `data`-axis
+    gather runs — 1/fsdp of the ZeRO-1 traffic — and the params stay
+    sharded end to end. Returns (param-layout updates, new LOCAL opt
+    state)."""
+    grads_local = _slice_placed(grads, update_placements)
+    params_local = _slice_placed(params_full, update_placements)
+    updates_local, new_opt_local = tx.update(
+        grads_local, opt_state_local, params_local
+    )
+
+    def regather(u, upl, ppl):
+        if upl.replicated:
+            return u
+        extra = upl.axes if ppl.replicated else upl.axes[len(ppl.axes):]
+        for ax in reversed(extra):
+            u = lax.all_gather(u, ax, axis=upl.dim, tiled=True)
+        return u
+
+    # component scope (obs/attrib.py): the sharded-optimizer traffic is its
+    # own attribution bucket, distinct from the elementwise optimizer math
+    with jax.named_scope("zero1_gather"):
+        updates = jax.tree.map(
+            regather, updates_local, update_placements, param_placements
+        )
+    return updates, new_opt_local
+
 # datasets without metric COLMAP scale: disparity point losses are off and the
 # scale factor is 1 (synthesis_task.py:216-218, :312)
 NO_DISP_SUPERVISION = ("flowers", "kitti_raw", "dtu")
@@ -534,16 +628,18 @@ def make_train_step(
     cfg: Config,
     model: MPINetwork,
     tx: optax.GradientTransformation,
-    axis_name: str | None = None,
+    axis_name: str | tuple[str, ...] | None = None,
     plane_axis: str | None = None,
     compositor: ops.Compositor | None = None,
-    zero1_dims: Any | None = None,
+    param_placements: Any | None = None,
+    update_placements: Any | None = None,
 ) -> Callable[[TrainState, dict[str, Array]], tuple[TrainState, dict[str, Array]]]:
     """Build the train-step function (one optimizer update,
     synthesis_task.py:627-635 under jit).
 
-    With `axis_name`, the function expects to run inside shard_map over that
-    mesh axis: per-replica RNG folding, the scalar loss pmean'd before
+    With `axis_name` — a single mesh axis or the ("data","fsdp") tuple one
+    logical batch spans — the function expects to run inside shard_map over
+    those axes: per-replica RNG folding, the scalar loss pmean'd before
     differentiation (which makes AD emit the global-batch gradient — the
     DDP-allreduce + SyncBN equivalent, SURVEY.md §2.4), logged losses
     pmean'd after.
@@ -579,12 +675,17 @@ def make_train_step(
     to a mesh-consistent verdict) so a single poisoned micro-batch masks
     the whole update bitwise, exactly as a poisoned batch does at k=1.
 
-    With `zero1_dims` (the per-leaf partition dims from
-    parallel/zero1.py, requires `axis_name`), the optimizer update runs
-    ZeRO-1: `state.opt_state` holds this device's SHARD of the Adam
-    moments, the update is computed on the shard from the (replicated,
-    already-reduced) grads, and an all_gather reassembles the full update
-    — grads are still reduced exactly once.
+    With `param_placements` / `update_placements` (the param-structured
+    Placement trees the partition-rule table resolves —
+    parallel/rules.py, via data_parallel._state_layout; require
+    `axis_name`), the step runs the sharded layouts: `state.params` holds
+    this device's FSDP shard of every fsdp-sharded leaf (all-gathered once
+    at step start, `fsdp_gather` scope), `state.opt_state` holds the local
+    shard of the Adam moments, the update is computed on the moment shard
+    from the (replicated-in-value, already-reduced) grads, and all_gathers
+    reassemble each update chunk back to its param's own layout
+    (`sharded_update`) — grads are still reduced exactly once, and the
+    params never exist unsharded outside the step.
 
     Sentinel instrumentation (resilience/sentinel.py): the returned
     loss_dict always carries `grad_norm` (the post-reduction global
@@ -599,9 +700,9 @@ def make_train_step(
         compositor = ops.compositor_from_config(cfg)
     sentinel_mask = cfg.resilience.sentinel_policy != "off"
     accum = max(int(cfg.training.accum_steps), 1)
-    if zero1_dims is not None and axis_name is None:
-        raise ValueError("ZeRO-1 shards over the data axis: axis_name is "
-                         "required when zero1_dims is given")
+    if update_placements is not None and axis_name is None:
+        raise ValueError("sharded layouts live on mesh axes: axis_name is "
+                         "required when update_placements is given")
 
     def micro_grads(params, batch_stats, batch, rng):
         """Forward + backward of one (micro-)batch: the unit both the
@@ -645,18 +746,20 @@ def make_train_step(
                 grads = lax.psum(grads, plane_axis)
         return grads
 
-    def apply_update(grads, opt_state, params):
-        if zero1_dims is not None:
-            # function-level import: mine_tpu.parallel imports this module
-            from mine_tpu.parallel.zero1 import shard_update
+    def apply_update(grads, opt_state, params_full):
+        if update_placements is not None:
+            return sharded_update(
+                tx, grads, opt_state, params_full,
+                update_placements, param_placements,
+            )
+        return tx.update(grads, opt_state, params_full)
 
-            return shard_update(tx, grads, opt_state, params, zero1_dims,
-                                axis_name)
-        return tx.update(grads, opt_state, params)
-
-    def accumulate(state: TrainState, batch: dict[str, Array], rng: Array):
+    def accumulate(params_full: Any, state: TrainState,
+                   batch: dict[str, Array], rng: Array):
         """k micro-steps -> (mean fp32 grads, mean loss_dict, final BN
-        stats, AND-of-micro finiteness), all pre-reduction."""
+        stats, AND-of-micro finiteness), all pre-reduction. `params_full`
+        is the (possibly fsdp-gathered) full param tree — gathered ONCE
+        outside the scan, not per micro-step."""
         b = jax.tree.leaves(batch)[0].shape[0]
         if b % accum:
             raise ValueError(
@@ -673,7 +776,7 @@ def make_train_step(
             # i.i.d. sampling per micro-batch: an unfolded key would give
             # every micro-batch the same disparity draw / dropout mask
             grads, (loss_dict, new_stats) = micro_grads(
-                state.params, stats, mb, jax.random.fold_in(rng, i)
+                params_full, stats, mb, jax.random.fold_in(rng, i)
             )
             # the per-micro flag catches poison the final post-reduction
             # check could in principle miss (e.g. inf micro-grads cancelling
@@ -695,7 +798,7 @@ def make_train_step(
         # scan); it is armed in case an outer grad ever does
         body = jax.checkpoint(body)
         zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            lambda p: jnp.zeros(p.shape, jnp.float32), params_full
         )
         (acc, new_stats), (loss_dicts, finite_flags) = lax.scan(
             body, (zeros, state.batch_stats), (micro, jnp.arange(accum))
@@ -709,11 +812,19 @@ def make_train_step(
     def train_step(state: TrainState, batch: dict[str, Array]):
         rng = jax.random.fold_in(state.rng, state.step)
         if axis_name is not None:
+            # a tuple axis_name yields the combined row-major replica index
             rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+        # FSDP weight gather, ONCE per step (and once per step under
+        # accumulation — outside the micro-batch scan): the only moment the
+        # full params exist on a device; everything upstream and downstream
+        # sees shards (obs/attrib.py buckets the traffic as fsdp_gather)
+        with jax.named_scope("fsdp_gather"):
+            params_full = _gather_placed(state.params, param_placements)
 
         if accum > 1:
             grads, loss_dict, new_stats, micro_finite = accumulate(
-                state, batch, rng
+                params_full, state, batch, rng
             )
             # the per-micro AND is computed from LOCAL losses/grads and can
             # disagree across devices (a NaN poisons one shard's flags
@@ -727,17 +838,18 @@ def make_train_step(
             micro_finite = micro_finite == 1.0
         else:
             grads, (loss_dict, new_stats) = micro_grads(
-                state.params, state.batch_stats, batch, rng
+                params_full, state.batch_stats, batch, rng
             )
             micro_finite = jnp.asarray(True)
         grads = reduce_grads(grads)
         if axis_name is not None:
             loss_dict = lax.pmean(loss_dict, axis_name)
-        # component scope (obs/attrib.py): the update math; the ZeRO-1
-        # all_gather inside carries its own zero1_gather scope
+        # component scope (obs/attrib.py): the update math; the sharded
+        # update's all_gathers inside carry their own zero1_gather scope.
+        # updates come back in the PARAMS' layout (fsdp shards stay shards)
         with jax.named_scope("optimizer"):
             updates, new_opt_state = apply_update(
-                grads, state.opt_state, state.params
+                grads, state.opt_state, params_full
             )
             new_params = optax.apply_updates(state.params, updates)
         # post-reduction, so every replica computes the identical norm and
@@ -773,25 +885,30 @@ def make_eval_step(
     cfg: Config,
     model: MPINetwork,
     lpips_params: dict | None = None,
-    axis_name: str | None = None,
+    axis_name: str | tuple[str, ...] | None = None,
     plane_axis: str | None = None,
     compositor: ops.Compositor | None = None,
+    param_placements: Any | None = None,
 ):
     """Eval step: same loss graph, eval-mode BN, no update
     (synthesis_task.py:496-527). Runs on every replica (the reference runs
-    eval on rank 0 only — SURVEY.md §5.3 lists that as a gap, not a feature)."""
+    eval on rank 0 only — SURVEY.md §5.3 lists that as a gap, not a
+    feature). With `param_placements` the incoming params are FSDP shards
+    and get the same one-shot gather the train step does."""
     if compositor is None:
         compositor = ops.compositor_from_config(cfg)
 
     def eval_step(state: TrainState, batch: dict[str, Array], key: Array):
         if axis_name is not None:
             key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        with jax.named_scope("fsdp_gather"):
+            params_full = _gather_placed(state.params, param_placements)
         batch = dict(batch)
         # per-example validity: 0.0 on wrap-padded val slots (data/llff.py
         # epoch), absent for datasets that never pad
         weight = batch.pop("eval_weight", None)
         _total, loss_dict, viz, _ = loss_fcn(
-            cfg, model, state.params, state.batch_stats, batch, key,
+            cfg, model, params_full, state.batch_stats, batch, key,
             is_val=True, lpips_params=lpips_params, train=False,
             plane_axis=plane_axis, compositor=compositor,
             per_example=True,

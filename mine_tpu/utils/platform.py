@@ -20,6 +20,13 @@ import sys
 
 from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
 
+# THE spelling of XLA's virtual-host-device flag. Lives HERE (stdlib-weight
+# module, importable by every pre-backend CLI guard without pulling the
+# parallel package) and is re-exported by parallel/mesh.py for mesh
+# consumers — everything that fakes a multi-device mesh references one of
+# the two names, so the spelling cannot drift.
+VIRTUAL_DEVICE_FLAG = "--xla_force_host_platform_device_count"
+
 
 def force_cpu_devices(
     n_devices: int,
@@ -46,9 +53,9 @@ def force_cpu_devices(
     flags = [
         f
         for f in os.environ.get("XLA_FLAGS", "").split()
-        if not f.startswith("--xla_force_host_platform_device_count")
+        if not f.startswith(VIRTUAL_DEVICE_FLAG)
     ]
-    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    flags.append(f"{VIRTUAL_DEVICE_FLAG}={n_devices}")
     if fast_compile:
         flags.append("--xla_llvm_disable_expensive_passes=true")
     os.environ["XLA_FLAGS"] = " ".join(flags)
@@ -147,7 +154,7 @@ def honor_jax_platforms() -> None:
     preset = [
         f
         for f in os.environ.get("XLA_FLAGS", "").split()
-        if f.startswith("--xla_force_host_platform_device_count=")
+        if f.startswith(VIRTUAL_DEVICE_FLAG + "=")
     ]
     n = int(preset[-1].split("=")[1]) if preset else 1
     force_cpu_devices(n)

@@ -3,9 +3,11 @@
 This is the JAX idiom for testing SPMD code without hardware (the reference
 has no analog — its multi-GPU behavior was only ever validated on real jobs,
 SURVEY.md §4). The forcing recipe (env flags + jax.config override, because
-the axon TPU PJRT plugin self-registers regardless of JAX_PLATFORMS) lives in
-__graft_entry__._force_virtual_cpu_mesh, shared with the driver's multichip
-dryrun; it must run before jax is imported anywhere.
+the axon TPU PJRT plugin self-registers regardless of JAX_PLATFORMS) is THE
+shared helper `mine_tpu.parallel.mesh.force_virtual_devices` — the same one
+the driver's multichip dryrun, the benches' forced-CPU paths, and the slow
+mesh-equivalence subprocesses use, so the flag spelling cannot drift between
+tests and production mesh code. It must run before any JAX backend touch.
 """
 
 import os
@@ -13,9 +15,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from __graft_entry__ import _force_virtual_cpu_mesh
+from mine_tpu.parallel.mesh import force_virtual_devices
 
-_force_virtual_cpu_mesh(8)
+force_virtual_devices(8)
 
 # The perf ledger (mine_tpu/obs/ledger.py) is append-only: without this,
 # every bench smoke (and the subprocesses they spawn — the env is

@@ -352,6 +352,31 @@ def test_ledger_streams_do_not_cross_contaminate(tmp_path):
     assert ledger.backend_class("tpu v4") != ledger.backend_class("cpu")
 
 
+def test_ledger_mesh_shape_isolates_streams(tmp_path):
+    """mesh_shape is part of the comparability key: a (4,2)-mesh run's
+    throughput never grades against the single-chip baseline stream —
+    and rows WITHOUT the field (pre-mesh history, trivial single-device
+    runs, which omit it) stay one continuous legacy stream."""
+    path = tmp_path / "l.jsonl"
+    # single-device history (no mesh_shape) + a slow multi-mesh newcomer
+    _append_rows(path, [50.0, 50.0, 49.0])
+    for v in (5.0, 5.0):
+        ledger.append(str(path), ledger.make_row(
+            "bench_imgs_per_sec", v, {"h": 128, "w": 128},
+            higher_is_better=True, device="cpu", backend="cpu (forced)",
+            mesh_shape="4x2x1"))
+    verdict = ledger.check(str(path))
+    # two distinct streams; neither regressed (the 10x gap is a LAYOUT
+    # difference, not a regression) — the mesh stream is still building
+    # history, the legacy stream is within threshold
+    assert verdict["ok"], verdict
+    assert len(verdict["checked"]) + len(verdict["skipped"]) == 2
+    row = ledger.make_row("m", 1.0, {}, mesh_shape="4x2x1")
+    legacy = ledger.make_row("m", 1.0, {})
+    assert ledger.stream_key(row) != ledger.stream_key(legacy)
+    assert "mesh_shape" not in legacy  # None is omitted, not stored
+
+
 def test_ledger_reader_skips_malformed_lines(tmp_path):
     path = tmp_path / "l.jsonl"
     _append_rows(path, [50.0, 50.0, 50.0])

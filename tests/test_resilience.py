@@ -829,15 +829,16 @@ def test_chaos_drill_training_half_smoke(tmp_path):
 @pytest.mark.slow
 def test_zero1_checkpoint_roundtrip_layout_independent(tiny_train_setup,
                                                        tmp_path):
-    """Save under ZeRO-1, restore into BOTH layouts. Checkpoints are
+    """Save under the sharded layout (ZeRO-1 rule rows + FSDP param rows
+    of the partition table), restore into BOTH layouts. Checkpoints are
     layout-free by construction — jax.device_get of a sharded opt state
-    gathers full arrays (gather-on-save) — so a ZeRO-1 run's checkpoint
+    gathers full arrays (gather-on-save) — so a sharded run's checkpoint
     restores into a replicated run and vice versa, and the last_good
     pointer + opt-layout sidecar coexist without interfering (the
     rollback/mid-epoch-resume machinery never sees the layout)."""
     import jax
 
-    from mine_tpu.parallel import make_mesh, replicate_state, zero1
+    from mine_tpu.parallel import make_mesh, replicate_state, rules
     from mine_tpu.training import checkpoint as ckpt
 
     cfg, state0, step_fn, batch_at = tiny_train_setup
@@ -846,9 +847,10 @@ def test_zero1_checkpoint_roundtrip_layout_independent(tiny_train_setup,
     state1, _ = step_fn(state0, batch_at(0))
     host1 = jax.device_get(state1)
 
-    mesh = make_mesh(data_parallel=8)
+    mesh = make_mesh(data_parallel=4, fsdp_parallel=2)
     min_size = cfg.parallel.zero1_min_size
-    placed = zero1.place_state(host1, mesh, min_size)
+    table = rules.partition_rules(cfg.replace(**{"parallel.zero1": True}))
+    placed = rules.place_state(table, host1, mesh, min_size)
     # at least one moment leaf actually sharded (not a vacuous test)
     assert any(
         len(getattr(leaf, "addressable_shards", [])) > 1
@@ -881,7 +883,9 @@ def test_zero1_checkpoint_roundtrip_layout_independent(tiny_train_setup,
     restored, step = ckpt.restore(ckpt.checkpoint_manager(ws), template)
     assert step == int(gathered.step)
     as_repl = jax.device_get(replicate_state(restored, mesh))
-    as_zero1 = jax.device_get(zero1.place_state(restored, mesh, min_size))
+    as_zero1 = jax.device_get(
+        rules.place_state(table, restored, mesh, min_size)
+    )
     for got in (as_repl, as_zero1):
         assert _tree_equal(got.opt_state, host1.opt_state)
         assert _tree_equal(got.params, host1.params)

@@ -1,0 +1,333 @@
+"""Tier-1 tests for the declarative partition-rule table
+(mine_tpu/parallel/rules.py) — the single source of param/grad/opt-state/
+batch shardings. Everything here is regex + shape arithmetic or
+jax.eval_shape: no XLA compiles, single-digit seconds total (ROADMAP
+tier-1 budget note)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mine_tpu.config import Config
+from mine_tpu.parallel import rules
+from mine_tpu.parallel.rules import (
+    REPLICATED,
+    Placement,
+    Rule,
+    match_partition_rules,
+    parse_rule,
+    partition_dim,
+    partition_rules,
+    resolve_placement,
+)
+
+MESH222 = {"data": 2, "fsdp": 2, "plane": 2}
+MESH8 = {"data": 8, "fsdp": 1, "plane": 1}
+
+
+# ------------------------------------------------------------ shape rule
+
+
+def test_partition_dim_is_pure_shape_function():
+    """The split decision depends only on the leaf SHAPE — so a param, its
+    grad, and its Adam moments (same shape by construction) always agree —
+    and prefers the largest dividing dimension. (The rule ZeRO-1 proved,
+    inherited verbatim by the table's resolution.)"""
+    # largest dim that divides n_shards wins, not the first
+    assert partition_dim((3, 3, 16, 2048), 8, 1024) == 3
+    assert partition_dim((2048, 16, 3, 3), 8, 1024) == 0
+    # small leaves, scalars, and non-dividing shapes replicate
+    assert partition_dim((64,), 8, 1024) == -1
+    assert partition_dim((), 8, 1024) == -1
+    assert partition_dim((6, 10, 30), 8, 1) == -1
+    # a 1-wide axis never shards
+    assert partition_dim((2048,), 1, 1024) == -1
+
+
+def test_resolution_is_anchored_left_to_right():
+    """Multi-axis rows anchor the dimension with the FIRST axis's size
+    alone, then extend — so a moment row ("fsdp","data") always lands on
+    the same dim its param's ("fsdp",) row picked for the same shape, even
+    when the full product would have preferred a different dim."""
+    # (4, 6): fsdp=2 anchors dim 1 (6 is largest, divides 2); the product
+    # 4 does NOT divide 6 -> the data extension is dropped, NOT re-anchored
+    # onto dim 0 (which 4 would divide — the trap anchoring exists for)
+    pl = resolve_placement((4, 6), ("fsdp", "data"), MESH222, 1)
+    assert pl == Placement(1, ("fsdp",))
+    # param row on the same shape: same dim — consistent by construction
+    assert resolve_placement((4, 6), ("fsdp",), MESH222, 1) == Placement(
+        1, ("fsdp",)
+    )
+    # divisible by the full product: the extension survives
+    pl = resolve_placement((3, 3, 16, 64), ("fsdp", "data"), MESH222, 1)
+    assert pl == Placement(3, ("fsdp", "data"))
+    # fsdp cannot shard the leaf at all -> falls through to data alone
+    pl = resolve_placement((5, 6), ("fsdp", "data"), {"data": 3, "fsdp": 4}, 1)
+    assert pl == Placement(1, ("data",))
+
+
+def test_resolution_drops_size1_axes():
+    """Size-1 mesh axes drop before resolution — how ("fsdp","data")
+    degrades to classic ZeRO-1 on an fsdp-less mesh, and to fully
+    replicated on a single device."""
+    pl = resolve_placement((3, 3, 16, 2048), ("fsdp", "data"), MESH8, 1024)
+    assert pl == Placement(3, ("data",))
+    pl = resolve_placement(
+        (3, 3, 16, 2048), ("fsdp", "data"),
+        {"data": 1, "fsdp": 1, "plane": 1}, 1024,
+    )
+    assert pl.replicated
+    # min_size replication survives axis dropping
+    assert resolve_placement((64,), ("fsdp", "data"), MESH8, 1024).replicated
+
+
+def test_pinned_dim_rows():
+    """Batch rows pin dim 0; non-divisibility is a loud error with the
+    leaf path in it, not a silently replicated batch."""
+    pl = resolve_placement((8, 128, 128, 3), ("data", "fsdp"), MESH222, 0,
+                           dim=0)
+    assert pl == Placement(0, ("data", "fsdp"))
+    with pytest.raises(ValueError, match="batch/src_img"):
+        resolve_placement((3, 128, 128, 3), ("data", "fsdp"), MESH222, 0,
+                          dim=0, path="batch/src_img")
+
+
+def test_placement_spec_rendering():
+    assert REPLICATED.spec() == P()
+    assert Placement(0, ("data", "fsdp")).spec() == P(("data", "fsdp"))
+    assert Placement(3, ("fsdp",)).spec() == P(None, None, None, "fsdp")
+    assert Placement(2, ("fsdp", "data")).spec() == P(
+        None, None, ("fsdp", "data")
+    )
+
+
+# ------------------------------------------------------- table semantics
+
+
+def test_first_match_wins_precedence():
+    table = (
+        Rule(r"kernel$", ("fsdp",)),
+        Rule(r".*", None),
+    )
+    tree = {"a": {"kernel": jnp.zeros((4, 64)), "bias": jnp.zeros((64,))}}
+    pl = match_partition_rules(table, tree, MESH222, 1)
+    assert pl["a"]["kernel"] == Placement(1, ("fsdp",))
+    assert pl["a"]["bias"].replicated
+    # reversed order: the catch-all shadows the kernel row entirely
+    pl = match_partition_rules(tuple(reversed(table)), tree, MESH222, 1)
+    assert pl["a"]["kernel"].replicated
+
+
+def test_unmatched_leaf_is_an_error():
+    table = (Rule(r"^params/", ("fsdp",)),)
+    with pytest.raises(ValueError, match="opt/mystery"):
+        match_partition_rules(
+            table, {"mystery": jnp.zeros((8,))}, MESH222, 1, prefix="opt"
+        )
+
+
+def test_parse_rule_rows():
+    assert parse_rule("^params/ = fsdp") == Rule("^params/", ("fsdp",))
+    assert parse_rule("^x = fsdp,data") == Rule("^x", ("fsdp", "data"))
+    assert parse_rule("^x = replicated") == Rule("^x", None)
+    assert parse_rule("^batch/ = data,fsdp @ 0") == Rule(
+        "^batch/", ("data", "fsdp"), 0
+    )
+    with pytest.raises(ValueError, match="unknown mesh axes"):
+        parse_rule("^x = tensor")
+    with pytest.raises(ValueError, match="pattern = axes"):
+        parse_rule("just-a-pattern")
+
+
+def test_config_rules_prepend_and_zero1_alias():
+    """parallel.rules rows come FIRST (override by precedence); the
+    retired parallel.zero1 knob flips the Adam-moment row between
+    (fsdp, data) and (fsdp,)."""
+    cfg = Config().replace(**{"parallel.zero1": True})
+    table = partition_rules(cfg)
+    moment_rule = next(r for r in table if "mu|nu" in r.pattern)
+    assert moment_rule.axes == ("fsdp", "data")
+    cfg = Config().replace(**{"parallel.zero1": False})
+    moment_rule = next(
+        r for r in partition_rules(cfg) if "mu|nu" in r.pattern
+    )
+    assert moment_rule.axes == ("fsdp",)
+    cfg = Config().replace(**{
+        "parallel.rules": ["^params/decoder/ = replicated"],
+    })
+    table = partition_rules(cfg)
+    assert table[0] == Rule("^params/decoder/", None)
+
+
+def test_batch_spec_reads_the_batch_row():
+    cfg = Config()
+    assert rules.batch_spec(partition_rules(cfg)) == P(("data", "fsdp"))
+    # an override can re-route it; a non-dim-0 batch row is rejected
+    over = partition_rules(
+        Config().replace(**{"parallel.rules": ["^batch/ = data @ 0"]})
+    )
+    assert rules.batch_spec(over) == P("data")
+    bad = partition_rules(
+        Config().replace(**{"parallel.rules": ["^batch/ = data @ 1"]})
+    )
+    with pytest.raises(ValueError, match="dim 0"):
+        rules.batch_spec(bad)
+
+
+# -------------------------------- full state trees (shapes via eval_shape)
+
+
+@pytest.fixture(scope="module")
+def state_shapes():
+    """TrainState of ShapeDtypeStructs for the tiny model — shapes without
+    a single XLA compile (the tier-1 budget discipline)."""
+    from mine_tpu.training import build_model, init_state, make_optimizer
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "model.num_layers": 18,
+        "model.dtype": "float32", "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2, "parallel.zero1": True,
+    })
+    model = build_model(cfg)
+    tx = make_optimizer(cfg, steps_per_epoch=100)
+    shapes = jax.eval_shape(
+        lambda key: init_state(cfg, model, tx, key, load_pretrained=False),
+        jax.random.PRNGKey(0),
+    )
+    return cfg, shapes
+
+
+def test_state_spec_tree_shapes(state_shapes):
+    """The table resolves the REAL TrainState: conv kernels P(..fsdp..),
+    Adam moments extend to (fsdp, data), biases/BN/batch_stats/step/rng
+    replicate — and every leaf is matched (no fall-through)."""
+    cfg, shapes = state_shapes
+    table = partition_rules(cfg)
+    placed = rules.state_placements(
+        table, shapes, MESH222, cfg.parallel.zero1_min_size
+    )
+    # params: at least one fsdp-sharded kernel; biases replicated
+    kernels = [
+        (jax.tree_util.keystr(p), pl)
+        for p, pl in jax.tree_util.tree_leaves_with_path(
+            placed.params, is_leaf=lambda x: isinstance(x, Placement)
+        )
+    ]
+    fsdp_kernels = [k for k, pl in kernels
+                    if not pl.replicated and pl.axes == ("fsdp",)]
+    assert fsdp_kernels, "no param leaf landed on the FSDP row"
+    assert all("kernel" in k for k in fsdp_kernels)
+    # opt state: moments of those same kernels extend over (fsdp, data)
+    opt_leaves = jax.tree_util.tree_leaves(
+        placed.opt_state, is_leaf=lambda x: isinstance(x, Placement)
+    )
+    assert any(pl.axes == ("fsdp", "data") for pl in opt_leaves)
+    # everything non-tensor replicates
+    assert placed.step.replicated and placed.rng.replicated
+    assert all(
+        pl.replicated for pl in jax.tree_util.tree_leaves(
+            placed.batch_stats, is_leaf=lambda x: isinstance(x, Placement)
+        )
+    )
+    # spec rendering round-trips through tree_specs
+    specs = rules.tree_specs(placed)
+    assert any(
+        s == P(None, None, None, "fsdp")
+        for s in jax.tree_util.tree_leaves(
+            specs.params, is_leaf=lambda x: isinstance(x, P)
+        )
+    )
+
+
+def test_fsdp_param_bytes_shrink_analytically(state_shapes):
+    """FSDP acceptance, the analytic half: per-device param bytes under
+    the (2,2,2) table < 1.0x replicated, and the ZeRO-1 moment bytes land
+    near 1/(fsdp*data) + the replicated-small-leaves epsilon. Pure
+    arithmetic over eval_shape leaves — the live-placement twin is the
+    slow mesh test."""
+    cfg, shapes = state_shapes
+    table = partition_rules(cfg)
+    min_size = cfg.parallel.zero1_min_size
+    placed = rules.state_placements(table, shapes, MESH222, min_size)
+    repl = rules.placement_bytes(
+        shapes.params,
+        jax.tree.map(lambda _: REPLICATED, shapes.params), MESH222,
+    )
+    sharded = rules.placement_bytes(shapes.params, placed.params, MESH222)
+    assert sharded < repl, (sharded, repl)
+    assert sharded >= repl // 2  # fsdp=2: at best halved
+    opt_repl = rules.placement_bytes(
+        shapes.opt_state,
+        jax.tree.map(lambda _: REPLICATED, shapes.opt_state), MESH222,
+    )
+    opt_sharded = rules.placement_bytes(
+        shapes.opt_state, placed.opt_state, MESH222
+    )
+    assert opt_sharded <= opt_repl * (0.25 + 0.05)  # fsdp*data = 4
+
+
+def test_inconsistent_override_rules_fail_loudly(state_shapes):
+    """A user row that shards params but replicates their moments (or
+    vice-versa on a different dim) must fail at placement time with the
+    leaf named — not inside a compiled step with a shape error."""
+    cfg, shapes = state_shapes
+    bad = Config().replace(**{
+        "parallel.zero1": True,
+        "parallel.rules": [r"^opt_state/.*\b(mu|nu)/ = replicated"],
+    })
+    with pytest.raises(ValueError, match="moments replicate"):
+        rules.state_placements(
+            partition_rules(bad), shapes, MESH222,
+            bad.parallel.zero1_min_size,
+        )
+
+
+def test_update_placements_param_structured(state_shapes):
+    """update_placements returns a PARAM-structured tree whose leaves
+    agree with the real moment leaves' placements (the probe-path
+    consistency the in-step sharded update relies on)."""
+    cfg, shapes = state_shapes
+    table = partition_rules(cfg)
+    upd = rules.update_placements(
+        table, shapes.params, MESH222, cfg.parallel.zero1_min_size
+    )
+    assert (jax.tree_util.tree_structure(jax.tree.map(lambda _: 0, shapes.params))
+            == jax.tree_util.tree_structure(
+                jax.tree.map(lambda _: 0, upd,
+                             is_leaf=lambda x: isinstance(x, Placement))))
+    placed = rules.state_placements(
+        table, shapes, MESH222, cfg.parallel.zero1_min_size
+    )
+    # every distinct placement that appears on a real mu leaf appears in
+    # the param-structured tree too (same multiset of sharded layouts)
+    def sharded_set(tree):
+        return {
+            (pl.dim, pl.axes)
+            for pl in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, Placement)
+            )
+            if not pl.replicated
+        }
+
+    assert sharded_set(upd) == sharded_set(placed.opt_state)
+
+
+def test_per_device_bytes_counts_local_shards():
+    """per_device_bytes: sharded leaves count the local shard, replicated
+    leaves the full size, host arrays one replica (the instrument behind
+    bench.py's param/opt byte fields)."""
+    from jax.sharding import Mesh, NamedSharding
+
+    from mine_tpu.parallel import AXIS_NAMES
+
+    devs = np.asarray(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(devs, AXIS_NAMES)
+    x = jnp.zeros((8, 16), jnp.float32)
+    repl = jax.device_put(x, NamedSharding(mesh, P()))
+    shard = jax.device_put(x, NamedSharding(mesh, P(("data", "fsdp"))))
+    dev = jax.devices()[0]
+    assert rules.per_device_bytes({"x": repl}, dev) == 8 * 16 * 4
+    assert rules.per_device_bytes({"x": shard}, dev) == 8 * 16 * 4 // 4
+    assert rules.per_device_bytes({"x": np.zeros((3, 3))}, dev) == 9 * 8

@@ -27,7 +27,8 @@ single-micro-batch floor the acceptance bound compares against. `value` is
 peak_bytes(accum=min)/peak_bytes(accum=max) at equal batch (>1 means
 accumulation peaks lower than the monolithic step).
 
-Claim 2 (parallel.zero1, parallel/zero1.py): Adam moments sharded over the
+Claim 2 (parallel.zero1 — the ZeRO-1 rule rows of the partition table,
+parallel/rules.py): Adam moments sharded over the
 data axis put ~1/n of the opt-state bytes on each device. With more than
 one device visible (the CPU fallback forces a virtual 8-device host) the
 bench places the SAME TrainState replicated and ZeRO-1 and reports
@@ -166,25 +167,28 @@ def _time_point(out: dict, compiled, state, batch, steps: int) -> dict:
 
 
 def _zero1_bytes(shared) -> dict | None:
-    """Per-device opt-state bytes, replicated vs ZeRO-1, on whatever mesh
-    the backend offers (>=2 devices; the CPU fallback forced 8 virtual
-    ones). Placement only — the numerics equivalence lives in
+    """Per-device opt-state bytes, replicated vs the ZeRO-1 rule rows of
+    the partition table (parallel/rules.py), on whatever mesh the backend
+    offers (>=2 devices; the CPU fallback forced 8 virtual ones).
+    Placement only — the numerics equivalence lives in
     tests/test_parallel.py, the bytes claim is what a bench can add."""
     import jax
 
-    from mine_tpu.parallel import make_mesh, replicate_state, zero1
+    from mine_tpu.parallel import make_mesh, replicate_state, rules
 
     n = len(jax.devices())
     if n < 2:
         return None
     cfg, _model, _tx, state = shared
+    zcfg = cfg.replace(**{"parallel.zero1": True})
     mesh = make_mesh(data_parallel=n)
     dev = jax.devices()[0]
-    repl = zero1.per_device_bytes(replicate_state(state, mesh).opt_state, dev)
-    shard = zero1.per_device_bytes(
-        zero1.place_state(state, mesh, cfg.parallel.zero1_min_size).opt_state,
-        dev,
+    repl = rules.per_device_bytes(replicate_state(state, mesh).opt_state, dev)
+    placed = rules.place_state(
+        rules.partition_rules(zcfg), state, mesh,
+        zcfg.parallel.zero1_min_size,
     )
+    shard = rules.per_device_bytes(placed.opt_state, dev)
     return {
         "devices": n,
         "opt_bytes_replicated_per_device": repl,

@@ -48,7 +48,13 @@ sys.path.insert(0, REPO_ROOT)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 # the smallest config the model architecture admits (H/W must be 128
-# multiples): every drill subprocess pays one tiny CPU compile
+# multiples): every drill subprocess pays one tiny CPU compile.
+# The training half runs on a NON-TRIVIAL (2 data x 2 fsdp) virtual mesh
+# with the ZeRO-1 rule rows live, so resilience — the in-graph sentinel
+# mask, the preemption save, the bitwise mid-epoch resume — is exercised
+# against SHARDED state (FSDP param shards + moment shards gathered on
+# save), not just replicated arrays.
+DRILL_MESH_DEVICES = 4
 TINY_OVERRIDES = {
     "data.name": "synthetic",
     "data.img_h": 128, "data.img_w": 128,
@@ -57,6 +63,9 @@ TINY_OVERRIDES = {
     "model.num_layers": 18, "model.dtype": "float32",
     "model.imagenet_pretrained": False,
     "mpi.num_bins_coarse": 2,
+    "mesh.data_parallel": 2,
+    "mesh.fsdp_parallel": 2,
+    "parallel.zero1": True,
     "training.epochs": 1,
     "training.log_interval": 1,
     "training.checkpoint_interval": 1000,  # only the preempt save writes
@@ -89,8 +98,14 @@ def _run_training(workspace: str, steps: int, faults: str,
     driver = os.path.join(os.path.dirname(workspace), "_drill_driver.py")
     with open(driver, "w") as fh:
         fh.write(_DRIVER.format(repo_root=REPO_ROOT))
+    from mine_tpu.parallel.mesh import VIRTUAL_DEVICE_FLAG
+
+    # the (2x2) drill mesh needs 4 virtual CPU devices in the CHILD, so the
+    # env var is the only channel; the trainer's honor_jax_platforms()
+    # preserves a preset count (one flag spelling: parallel/mesh.py)
     env = dict(os.environ, JAX_PLATFORMS="cpu", MINE_TPU_FAULTS=faults,
-               PYTHONPATH=REPO_ROOT)
+               PYTHONPATH=REPO_ROOT,
+               XLA_FLAGS=f"{VIRTUAL_DEVICE_FLAG}={DRILL_MESH_DEVICES}")
     return subprocess.run(
         [sys.executable, driver, json.dumps(TINY_OVERRIDES), workspace,
          str(steps)],

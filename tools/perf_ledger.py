@@ -5,7 +5,10 @@ The ledger itself (mine_tpu/obs/ledger.py) is auto-appended by bench.py,
 tools/bench_serve.py, and tools/bench_accum.py. This tool reads it:
 
   check   compare the newest row of every comparable stream
-          (metric, config digest, device, backend class) against the
+          (metric, config digest, device, backend class, mesh shape —
+          a (4,2)-mesh run never grades against a single-chip baseline
+          stream; rows without mesh_shape are the single-device legacy
+          stream) against the
           median of its prior rows; exit 1 when any checked field —
           value, p95_ms, peak_hbm_bytes — regressed beyond --threshold.
           Streams with < --min-history prior rows are skipped, not
