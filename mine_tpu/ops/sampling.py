@@ -13,29 +13,48 @@ import jax.numpy as jnp
 from jax import Array
 
 
+def _stratified_uniform(key: Array, batch_size: int, num_bins: int) -> Array:
+    """(B, S) uniforms where row i depends only on (key, i), never on B.
+
+    A single `jax.random.uniform(key, (B, S))` draw gives example i
+    DIFFERENT bits under different batch sizes, which breaks the eval
+    wrap-pad contract (training/step.py make_eval_step): a weight-0 padded
+    duplicate must leave the genuine examples' losses bit-identical to the
+    unpadded batch, and that requires every per-example quantity —
+    including the sampled plane disparities — to be a function of the
+    example alone. Per-row `fold_in` keys make the draw batch-size
+    invariant (prefix-stable: row i is the same in a B=1 and a B=8 batch).
+    """
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(batch_size, dtype=jnp.uint32)
+    )
+    return jax.vmap(lambda k: jax.random.uniform(k, (num_bins,)))(keys)
+
+
 def uniform_disparity_from_linspace_bins(
     key: Array, batch_size: int, num_bins: int, start: float, end: float
 ) -> Array:
     """Stratified disparity samples: one uniform draw inside each of S linspace
     bins spanning [start, end], start > end (descending disparity = near-to-far
     planes). Reference: rendering_utils.py:70-88.
-    Returns (B, S).
+    Returns (B, S); row i is batch-size invariant (see _stratified_uniform).
     """
     assert start > end, "disparity must descend (near plane first)"
     edges = jnp.linspace(start, end, num_bins + 1)
     interval = edges[1] - edges[0]  # negative
-    u = jax.random.uniform(key, (batch_size, num_bins))
+    u = _stratified_uniform(key, batch_size, num_bins)
     return edges[None, :-1] + interval * u
 
 
 def uniform_disparity_from_bins(key: Array, batch_size: int, disparity_edges: Array) -> Array:
     """Stratified samples from explicit (S+1,) bin edges, descending.
-    Reference: rendering_utils.py:47-67. Returns (B, S).
+    Reference: rendering_utils.py:47-67. Returns (B, S); row i is
+    batch-size invariant (see _stratified_uniform).
     """
     edges = jnp.asarray(disparity_edges, dtype=jnp.float32)
     interval = edges[1:] - edges[:-1]  # (S,)
     s = edges.shape[0] - 1
-    u = jax.random.uniform(key, (batch_size, s))
+    u = _stratified_uniform(key, batch_size, s)
     return edges[None, :-1] + interval[None, :] * u
 
 

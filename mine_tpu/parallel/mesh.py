@@ -65,7 +65,32 @@ def force_virtual_devices(
     )
 
 
-def init_multihost(coordinator: str | None = None) -> None:
+class MultihostInitTimeout(RuntimeError):
+    """jax.distributed.initialize() did not complete within the bring-up
+    deadline. The named, actionable replacement for the indefinite hang a
+    missing peer otherwise produces (the rendezvous blocks until EVERY host
+    of the pod dials in — one crashed worker used to stall the rest
+    forever with no diagnosis)."""
+
+    def __init__(self, timeout_s: float, coordinator: str | None):
+        super().__init__(
+            f"multi-host bring-up did not complete within {timeout_s:.0f}s: "
+            "jax.distributed.initialize() is still waiting for peers. "
+            "Check that every host of the pod launched the same job, that "
+            f"the coordinator {coordinator or '(auto-detected)'} is "
+            "reachable (firewall / DNS), and that MINE_TPU_MULTIHOST or "
+            "--coordinator was not set on a single-host run. Extend the "
+            "deadline with MINE_TPU_MULTIHOST_TIMEOUT_S."
+        )
+        self.timeout_s = timeout_s
+        self.coordinator = coordinator
+
+
+def init_multihost(
+    coordinator: str | None = None,
+    timeout_s: float | None = None,
+    initialize_fn=None,
+) -> None:
     """Multi-host bootstrap (reference: torch.distributed.launch + NCCL TCP
     rendezvous, start_training.sh:75-83). On TPU pods jax.distributed
     discovers topology from the environment; coordinator is only needed for
@@ -79,19 +104,52 @@ def init_multihost(coordinator: str | None = None) -> None:
     set. jax.distributed.initialize()'s auto-detection BLOCKS waiting for
     peers on some single-chip environments (observed with tunneled TPU
     metadata), so it must never fire implicitly on single-host runs.
+
+    Bring-up deadline: the rendezvous runs on a worker thread joined for
+    `timeout_s` (default $MINE_TPU_MULTIHOST_TIMEOUT_S, else 300). On
+    expiry this raises MultihostInitTimeout instead of hanging the job
+    launcher forever — the operator gets the missing-peer diagnosis, the
+    scheduler gets a dead process it can reschedule. `initialize_fn`
+    overrides jax.distributed.initialize (unit tests inject a fake
+    distributed client; production never passes it).
     """
     import os
+    import threading
     import warnings
 
     if coordinator is None and not os.environ.get("MINE_TPU_MULTIHOST"):
         return
-    try:
-        if coordinator:
-            jax.distributed.initialize(coordinator_address=coordinator)
-        else:
-            jax.distributed.initialize()
-    except RuntimeError as e:
-        msg = str(e)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("MINE_TPU_MULTIHOST_TIMEOUT_S", 300))
+    if initialize_fn is None:
+        initialize_fn = jax.distributed.initialize
+
+    outcome: list[BaseException | None] = []
+
+    def bring_up():
+        try:
+            if coordinator:
+                initialize_fn(coordinator_address=coordinator)
+            else:
+                initialize_fn()
+            outcome.append(None)
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            outcome.append(e)
+
+    # daemon thread: on timeout the stuck rendezvous cannot be cancelled,
+    # but it must not pin the process open after the launcher gives up
+    worker = threading.Thread(
+        target=bring_up, name="mine-multihost-init", daemon=True
+    )
+    worker.start()
+    worker.join(timeout_s)
+    if worker.is_alive():
+        raise MultihostInitTimeout(timeout_s, coordinator)
+    exc = outcome[0] if outcome else None
+    if exc is None:
+        return
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
         if "already initialized" in msg:
             return
         if "must be called before" in msg:
@@ -108,11 +166,12 @@ def init_multihost(coordinator: str | None = None) -> None:
         if coordinator is None:
             # no cluster environment detected: plain single-host run
             return
-        raise
-    except ValueError:
+        raise exc
+    if isinstance(exc, ValueError):
         if coordinator is None:
             return  # auto-detection found no cluster env: single-host
-        raise
+        raise exc
+    raise exc
 
 
 def make_mesh(

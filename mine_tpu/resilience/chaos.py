@@ -38,6 +38,17 @@ Kinds and their seams:
   engine_raise@render=N  serving/engine.py raises on the Nth render
                        dispatch (proves breaker trip + 500-not-hang).
   predict_raise@predict=N  serving/engine.py raises on the Nth predict.
+  corrupt_swap@swap=N  serving/server.py's hot-swap worker raises while
+                       loading the Nth swap's checkpoint (the in-process
+                       stand-in for a corrupt/truncated checkpoint file;
+                       proves rejected-swap rollback: old generation keeps
+                       serving, named error + counter, no 5xx).
+  replica_kill@request=N  serving/server.py kills THIS replica's HTTP
+                       server on its Nth handled request: the listener
+                       closes and the triggering connection drops with no
+                       response — exactly what a fleet router sees when a
+                       replica dies mid-flood (proves failover + ring
+                       convergence, tools/chaos_drill.py fleet half).
 
 Two trigger styles share one `should()` call: value-keyed kinds (counter
 `step`) fire when the caller's `at=` equals the trigger; invocation-keyed
@@ -65,6 +76,8 @@ KINDS: dict[str, str] = {
     "loader_raise": "batch",
     "engine_raise": "render",
     "predict_raise": "predict",
+    "corrupt_swap": "swap",
+    "replica_kill": "request",
 }
 _VALUE_KEYED = frozenset(k for k, c in KINDS.items() if c == "step")
 

@@ -15,14 +15,30 @@ video render; this subsystem turns it into a service:
   * batcher.py — micro-batching queue coalescing concurrent render requests
     against the same cached MPI into one render-many dispatch.
   * server.py  — stdlib ThreadingHTTPServer exposing /predict, /render,
-    /healthz, /metrics (no new dependencies).
+    /healthz, /metrics, /admin/swap (no new dependencies).
   * metrics.py — the serving metric set on mine_tpu.utils.metrics'
     Prometheus-text registry.
+  * fleet.py   — the multi-replica front: consistent-hash digest-affinity
+    routing over health-gated replicas, bounded failover, deadline
+    propagation, mine_fleet_* metrics, /admin/swap fan-out.
+  * fake.py    — FakeEngine: the whole serving stack minus XLA, for
+    compile-free fleet/swap tests and the chaos drill's fleet half.
+
+Hot swap: engine.py owns WeightSet generations + swap_weights (validate →
+place → verify → atomic flip; SwapRejected rolls back to the serving
+generation), server.py owns the orchestration (POST /admin/swap, the
+last_good promotion watch).
 """
 
 from mine_tpu.serving.batcher import MicroBatcher
 from mine_tpu.serving.cache import MPICache, MPIEntry, mpi_key
-from mine_tpu.serving.engine import RenderEngine
+from mine_tpu.serving.engine import (
+    RenderEngine,
+    SwapError,
+    SwapInProgress,
+    SwapRejected,
+    WeightSet,
+)
 from mine_tpu.serving.metrics import ServingMetrics
 
 # server.py (ServingApp, make_server, the CLI) is imported directly, not

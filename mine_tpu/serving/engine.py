@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any
 
 import numpy as np
@@ -50,6 +51,40 @@ from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
 BucketSpec = tuple[int, int, int]  # (H, W, S_coarse)
 
 _IDENTITY_POSE = np.eye(4, dtype=np.float32)
+
+
+class SwapError(RuntimeError):
+    """Base of the named hot-swap failure modes. Every subclass means the
+    PREVIOUS generation is still serving — a swap never takes the engine
+    down, it either flips atomically or leaves everything as it was."""
+
+
+class SwapRejected(SwapError):
+    """The candidate weights failed validation (tree structure/shape
+    mismatch, or the verification dispatch raised). Old generation keeps
+    serving."""
+
+
+class SwapInProgress(SwapError):
+    """A swap is already running; concurrent swaps never interleave."""
+
+
+@dataclass(frozen=True)
+class WeightSet:
+    """One immutable weight generation: the device-resident variables, the
+    checkpoint step they came from, and a monotonically increasing
+    generation id. predict() reads ONE WeightSet reference for its whole
+    dispatch, so an in-flight predict completes on the generation it
+    started on even if a swap flips mid-dispatch; render() never touches
+    weights at all (it consumes cached MPIEntries), so renders are
+    generation-free by construction. The MPICache keys on checkpoint_step,
+    which fences stale MPIs: post-swap predicts mint new keys, pre-swap
+    entries stay servable for clients still holding their mpi_key (they
+    age out via LRU)."""
+
+    variables: Any
+    checkpoint_step: int
+    generation: int
 
 
 def _abstract(tree: Any) -> Any:
@@ -213,17 +248,14 @@ class RenderEngine:
         # to replicated, and the placement is an OPTIMIZATION — an exotic
         # checkpoint whose variables a table row fails to match falls back
         # to the plain replicated device_put instead of failing startup.
-        variables = {"params": params, "batch_stats": batch_stats}
-        try:
-            shardings = self._placement_shardings(cfg, params, batch_stats)
-            self.variables = jax.device_put(variables, shardings)
-        except ValueError as exc:
-            import sys
-
-            print(f"# serving placement fell back to plain device_put "
-                  f"(partition-rule table: {exc})", file=sys.stderr)
-            self.variables = jax.device_put(variables)
-        self.checkpoint_step = int(checkpoint_step)
+        self._weights = WeightSet(
+            variables=self._place_variables(params, batch_stats),
+            checkpoint_step=int(checkpoint_step),
+            generation=0,
+        )
+        # serializes swap_weights callers; predict never takes it (the
+        # atomic _weights reference read is the whole synchronization)
+        self._swap_lock = threading.Lock()
         self.metrics = metrics
         # request-scoped spans (X-Request-Id): predict/render dispatches
         # land in the same ring the HTTP handler spans use, so
@@ -245,7 +277,132 @@ class RenderEngine:
         self._buckets: dict[BucketSpec, _Bucket] = {}
         self._buckets_lock = threading.Lock()
 
+    # -- weight generations --------------------------------------------------
+
+    @property
+    def variables(self) -> Any:
+        """The serving generation's device-resident variables."""
+        return self._weights.variables
+
+    @property
+    def checkpoint_step(self) -> int:
+        """The serving generation's checkpoint step (MPI cache key part)."""
+        return self._weights.checkpoint_step
+
+    @property
+    def generation(self) -> int:
+        return self._weights.generation
+
+    def weights(self) -> WeightSet:
+        """One consistent snapshot of (variables, checkpoint_step,
+        generation). Callers that compute a cache key AND dispatch a
+        predict must read this ONCE and use it for both — reading
+        engine.checkpoint_step and engine.variables separately can
+        straddle a swap and cache a new-generation MPI under the old
+        step's key."""
+        return self._weights
+
+    def swap_weights(
+        self,
+        params: Any,
+        batch_stats: Any,
+        checkpoint_step: int,
+        verify: bool = True,
+    ) -> WeightSet:
+        """Hot-swap to a new weight generation; returns the new WeightSet.
+
+        The sequence — validate, place, re-prove the warm buckets, flip —
+        runs entirely while the OLD generation serves traffic:
+
+          1. validate: the candidate tree must match the serving tree's
+             structure/shapes/dtypes exactly (checkpoint.py
+             validate_variables_tree). The AOT executables are pure
+             functions of abstract shapes, so this is precisely the
+             condition under which every warm bucket's executable set
+             carries over unchanged — a shape-mismatched checkpoint is a
+             SwapRejected here, never a compile failure mid-request.
+          2. place: device_put through the partition-rule table (same
+             fallback as startup).
+          3. verify (re-AOT + prove): for every warm bucket, (re)build its
+             predict executable — a no-op when already compiled, the
+             background compile when a swap races bucket warm-up — and run
+             ONE dispatch against the NEW variables with a zeros image.
+             A candidate that cannot execute (poisoned buffers, a device
+             rejection) fails HERE, on the swap thread, not on the first
+             live request after the flip.
+          4. flip: one atomic reference assignment. In-flight predicts
+             keep their snapshot; the old variables free once the last
+             in-flight dispatch drops them.
+
+        Raises SwapRejected (validation/verify failed — old generation
+        still serving) or SwapInProgress (another swap holds the lock).
+        """
+        from mine_tpu.training.checkpoint import (
+            CheckpointTreeMismatch,
+            validate_variables_tree,
+        )
+
+        if not self._swap_lock.acquire(blocking=False):
+            raise SwapInProgress("a weight swap is already in progress")
+        try:
+            serving = self._weights
+            candidate = {"params": params, "batch_stats": batch_stats}
+            try:
+                validate_variables_tree(
+                    _abstract(serving.variables), candidate,
+                    context=f"swap candidate (step {checkpoint_step}) vs "
+                            f"serving generation {serving.generation}",
+                )
+            except CheckpointTreeMismatch as exc:
+                raise SwapRejected(str(exc)) from exc
+            placed = self._place_variables(params, batch_stats)
+            if verify:
+                for spec in self.bucket_specs():
+                    bucket = self.bucket(spec)
+                    h, w, _ = spec
+                    try:
+                        self._dispatch_predict(
+                            bucket,
+                            np.zeros((1, h, w, 3), np.float32),
+                            placed,
+                        )
+                    except Exception as exc:  # noqa: BLE001 - named rollback
+                        raise SwapRejected(
+                            f"verification dispatch failed on bucket "
+                            f"{spec}: {type(exc).__name__}: {exc}"
+                        ) from exc
+            new = WeightSet(
+                variables=placed,
+                checkpoint_step=int(checkpoint_step),
+                generation=serving.generation + 1,
+            )
+            self._weights = new  # the atomic flip
+            if self.metrics is not None:
+                self.metrics.weight_generation.set(new.generation)
+            return new
+        finally:
+            self._swap_lock.release()
+
     # -- internals -----------------------------------------------------------
+
+    def _place_variables(self, params: Any, batch_stats: Any) -> Any:
+        """device_put a host variables tree through the partition-rule
+        table (fallback: plain replicated placement) — shared by startup
+        and every hot swap."""
+        import jax
+
+        variables = {"params": params, "batch_stats": batch_stats}
+        try:
+            shardings = self._placement_shardings(
+                self.base_cfg, params, batch_stats
+            )
+            return jax.device_put(variables, shardings)
+        except ValueError as exc:
+            import sys
+
+            print(f"# serving placement fell back to plain device_put "
+                  f"(partition-rule table: {exc})", file=sys.stderr)
+            return jax.device_put(variables)
 
     def _placement_shardings(self, cfg, params, batch_stats):
         """NamedShardings for the resident variables from the partition-rule
@@ -322,35 +479,45 @@ class RenderEngine:
 
     # -- the two halves ------------------------------------------------------
 
+    def _dispatch_predict(self, bucket: _Bucket, img: Any, variables: Any):
+        """One predict-executable dispatch against an explicit variables
+        tree; returns (mpi_rgb, mpi_sigma, disparity). Shared by live
+        predicts and the swap path's verification dispatch."""
+        exe = bucket.predict_executable()
+        if bucket.is_c2f:
+            return exe(variables, img, bucket.k)
+        mpi_rgb, mpi_sigma = exe(variables, img, bucket.disparity, bucket.k)
+        return mpi_rgb, mpi_sigma, bucket.disparity
+
     def predict(
         self, image: np.ndarray, spec: BucketSpec | None = None,
         request_id: str | None = None,
+        weights: WeightSet | None = None,
     ) -> MPIEntry:
         """Run the encoder-decoder once; returns a device-resident MPIEntry.
 
         image: (h, w, 3) uint8 or float in [0, 1] at any resolution — it is
         resized to the bucket's (H, W) exactly like the one-shot CLI
         (inference/video.py prepare_image).
+
+        weights: an explicit WeightSet snapshot (engine.weights()) so the
+        caller's cache key and this dispatch are guaranteed the same
+        generation across a concurrent hot swap; defaults to the serving
+        generation at call time.
         """
         from mine_tpu.inference.video import prepare_image
 
         chaos.maybe_raise("predict_raise")  # fault seam (resilience/chaos.py)
+        ws = weights if weights is not None else self._weights
         bucket = self.bucket(spec)
         h, w, _ = bucket.spec
         with self.tracer.span("engine_predict", cat="serve",
                               bucket=str(bucket.spec),
                               request_id=request_id):
             img = prepare_image(image, h, w)
-            exe = bucket.predict_executable()
-            if bucket.is_c2f:
-                mpi_rgb, mpi_sigma, disparity = exe(
-                    self.variables, img, bucket.k
-                )
-            else:
-                mpi_rgb, mpi_sigma = exe(
-                    self.variables, img, bucket.disparity, bucket.k
-                )
-                disparity = bucket.disparity
+            mpi_rgb, mpi_sigma, disparity = self._dispatch_predict(
+                bucket, img, ws.variables
+            )
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
             if bucket.predict_cost is not None and bucket.predict_cost.flops:

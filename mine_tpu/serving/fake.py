@@ -1,0 +1,161 @@
+"""FakeEngine: the serving stack with the XLA halves stubbed out.
+
+The fleet-resilience surfaces — hot-swap state machine, consistent-hash
+routing, health-gated ring membership, failover, the chaos drill's fleet
+half — are all control-plane logic whose correctness has NOTHING to do
+with the model. Proving them through real encoder compiles would cost
+~30s per replica on this box (K replicas per test!), so this module gives
+them a drop-in engine whose predict/render are cheap numpy while
+EVERYTHING else is the production code path: `FakeEngine` subclasses
+RenderEngine, so bucket validation, the WeightSet generation machinery,
+`swap_weights`' validate/place/verify/flip sequence, the chaos seams, and
+the metrics plumbing are the real implementations — only the executable
+dispatch is replaced.
+
+Usage (tests/test_fleet.py, tools/bench_fleet.py, tools/chaos_drill.py):
+
+    app = make_fake_app(checkpoint_step=3,
+                        swap_source=lambda: fake_checkpoint(4))
+    server = make_server(app)   # the real HTTP surface
+
+A fake render fills every frame with a constant derived from the MPI's
+fill value, which `predict` derives from the generation's checkpoint
+step — so an end-to-end test can read a rendered pixel and know which
+weight generation produced it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from mine_tpu.config import Config
+from mine_tpu.resilience import chaos
+from mine_tpu.serving.cache import MPIEntry
+from mine_tpu.serving.engine import RenderEngine, WeightSet
+
+
+def fake_variables(checkpoint_step: int = 0) -> tuple[dict, dict]:
+    """(params, batch_stats) for a FakeEngine: a tiny tree whose single
+    leaf's VALUE carries the step (so a swapped-in tree is distinguishable)
+    while its shape/dtype stay fixed (so swaps between fake checkpoints
+    pass tree validation, like real same-architecture checkpoints do)."""
+    return (
+        {"w": np.full((4,), float(checkpoint_step), np.float32)},
+        {},
+    )
+
+
+def fake_checkpoint(checkpoint_step: int) -> tuple[dict, dict, int]:
+    """A swap_source payload: (params, batch_stats, step)."""
+    params, batch_stats = fake_variables(checkpoint_step)
+    return params, batch_stats, checkpoint_step
+
+
+class FakeEngine(RenderEngine):
+    """RenderEngine with numpy predict/render dispatches.
+
+    Inherits the real bucket validation, weights()/swap_weights()
+    generation machinery, and metrics wiring; overrides only
+    `_dispatch_predict` (used by live predicts AND the swap path's
+    verification dispatch) and `render`. `render_delay_s` /
+    `predict_delay_s` are mutable knobs for overload scenarios."""
+
+    def __init__(
+        self,
+        cfg: Config | None = None,
+        checkpoint_step: int = 0,
+        render_delay_s: float = 0.0,
+        predict_delay_s: float = 0.0,
+        **kwargs: Any,
+    ):
+        if cfg is None:
+            cfg = Config().replace(**{
+                "data.img_h": 128, "data.img_w": 128,
+                "mpi.num_bins_coarse": 2,
+            })
+        params, batch_stats = fake_variables(checkpoint_step)
+        super().__init__(cfg, params, batch_stats,
+                         checkpoint_step=checkpoint_step, **kwargs)
+        self.render_delay_s = render_delay_s
+        self.predict_delay_s = predict_delay_s
+
+    def _place_variables(self, params: Any, batch_stats: Any) -> Any:
+        # host numpy stays host numpy: no jax backend touch, no stderr
+        # fallback note per construction (the fake tree matches no
+        # partition rule by design)
+        return {"params": params, "batch_stats": batch_stats}
+
+    def _dispatch_predict(self, bucket, img, variables):
+        if self.predict_delay_s:
+            time.sleep(self.predict_delay_s)
+        h, w, _ = bucket.spec
+        s = bucket.num_planes
+        fill = float(np.asarray(variables["params"]["w"]).flat[0])
+        # rgb encodes the producing generation's step (clipped to [0, 1]
+        # at render time); sigma dense enough that frames aren't empty
+        mpi_rgb = np.full((1, s, h, w, 3), fill, np.float32)
+        mpi_sigma = np.full((1, s, h, w, 1), 5.0, np.float32)
+        disparity = np.linspace(1.0, 0.01, s, dtype=np.float32)[None]
+        return mpi_rgb, mpi_sigma, disparity
+
+    def predict(
+        self, image: np.ndarray, spec=None, request_id: str | None = None,
+        weights: WeightSet | None = None,
+    ) -> MPIEntry:
+        chaos.maybe_raise("predict_raise")  # same seam as the real engine
+        ws = weights if weights is not None else self._weights
+        bucket = self.bucket(spec)
+        mpi_rgb, mpi_sigma, disparity = self._dispatch_predict(
+            bucket, image, ws.variables
+        )
+        if self.metrics is not None:
+            self.metrics.encoder_invocations.inc()
+        return MPIEntry(
+            mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma, disparity=disparity,
+            k=np.eye(3, dtype=np.float32)[None], bucket=bucket.spec,
+        )
+
+    def render(
+        self, entry: MPIEntry, poses: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        chaos.maybe_raise("engine_raise")  # same seam as the real engine
+        poses = np.asarray(poses, np.float32)
+        if poses.ndim != 3 or poses.shape[1:] != (4, 4):
+            raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")
+        if self.render_delay_s:
+            time.sleep(self.render_delay_s)
+        n = poses.shape[0]
+        h, w, _ = entry.bucket
+        fill = float(np.clip(np.asarray(entry.mpi_rgb).flat[0], 0.0, 1.0))
+        rgb = np.full((n, h, w, 3), fill, np.float32)
+        disp = np.full((n, h, w, 1), 0.5, np.float32)
+        if self.metrics is not None:
+            self.metrics.rendered_frames.inc(n)
+            self.metrics.renders_per_sec.record(n)
+        return rgb, disp
+
+
+def make_fake_app(
+    checkpoint_step: int = 0,
+    swap_source: Callable | str | None = None,
+    render_delay_s: float = 0.0,
+    predict_delay_s: float = 0.0,
+    cfg: Config | None = None,
+    **app_kwargs: Any,
+):
+    """A full ServingApp (real cache/batcher/breaker/metrics/HTTP wiring)
+    over a FakeEngine — zero XLA compiles. Extra kwargs go to ServingApp."""
+    from mine_tpu.serving.server import ServingApp
+
+    engine = FakeEngine(
+        cfg=cfg, checkpoint_step=checkpoint_step,
+        render_delay_s=render_delay_s, predict_delay_s=predict_delay_s,
+    )
+    app_kwargs.setdefault("max_delay_ms", 0.0)
+    return ServingApp(
+        engine.base_cfg, engine=engine, swap_source=swap_source,
+        **app_kwargs,
+    )

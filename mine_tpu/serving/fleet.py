@@ -1,0 +1,719 @@
+"""Multi-replica fleet front: digest-affinity routing over health-gated
+replicas.
+
+MINE's predict-once/render-many split makes the MPI cache the unit of
+serving economics: one encoder pass amortizes over every render of that
+image, but ONLY on the replica holding the cached MPI. So the fleet's
+routing key is the image digest (the first component of every mpi_key) and
+the routing function is a consistent-hash ring — cache hits concentrate
+per replica, and a membership change remaps only the dead replica's arc
+instead of reshuffling every digest (which would cold-miss the whole
+fleet's cache at once).
+
+Pieces, all stdlib + injectable for deterministic tests:
+
+  HashRing     consistent hashing with virtual nodes; `candidates(digest)`
+               yields the orderd failover sequence (owner first, then the
+               next distinct replicas clockwise).
+  HealthGate   per-replica probe hysteresis: `down_after` consecutive
+               failures eject, `up_after` consecutive successes readmit —
+               one flaky probe cannot flap the ring.
+  FleetApp     the routing logic: forward with bounded failover retries on
+               connect-error/503 (a 503's Retry-After opens a per-replica
+               cooldown the router honors before re-offering it traffic),
+               deadline propagation (each attempt gets the REMAINING
+               budget, expiry is an honest 504), request-path failure
+               signals feeding the same hysteresis gate as the probe loop,
+               `mine_fleet_*` metrics, an aggregated /healthz, and
+               /admin/swap fan-out (a training job promotes weights into
+               the whole fleet through one endpoint).
+  FleetHTTPServer / main()  the stdlib HTTP surface + CLI, mirroring
+               serving/server.py.
+
+Numerics: routing and failover never touch pixels — a fleet answer is byte
+-identical to the owning replica's answer (PARITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import hashlib
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from mine_tpu.utils.metrics import MetricsRegistry
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every candidate was down/cooling/exhausted — maps to HTTP 503."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"no replica available; retry after {retry_after_s:.1f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class FleetDeadlineExceeded(RuntimeError):
+    """The request's deadline expired before any replica answered — 504."""
+
+
+def _point(name: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(name.encode()).digest()[:8], "big"
+    )
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (replicated hash points per
+    member smooth the arc distribution, the classic Karger construction).
+    Immutable once built — membership changes build a new ring, so readers
+    never see a half-updated point list."""
+
+    def __init__(self, members: list[str], vnodes: int = 64):
+        self.members = sorted(set(members))
+        self.vnodes = vnodes
+        points: list[tuple[int, str]] = []
+        for m in self.members:
+            for v in range(vnodes):
+                points.append((_point(f"{m}#{v}"), m))
+        points.sort()
+        self._hashes = [p[0] for p in points]
+        self._owners = [p[1] for p in points]
+
+    def candidates(self, digest: str) -> list[str]:
+        """Every member, ordered by ring distance from the digest's point:
+        the owner first, then the failover sequence. Deterministic for a
+        given membership, so retries and cache affinity agree."""
+        if not self.members:
+            return []
+        start = bisect.bisect_left(self._hashes, _point(digest))
+        seen: list[str] = []
+        n = len(self._owners)
+        for i in range(n):
+            owner = self._owners[(start + i) % n]
+            if owner not in seen:
+                seen.append(owner)
+                if len(seen) == len(self.members):
+                    break
+        return seen
+
+
+class HealthGate:
+    """Hysteresis for one replica's membership: state flips DOWN only after
+    `down_after` consecutive bad observations and back UP only after
+    `up_after` consecutive good ones. Probe results and request-path
+    connect errors feed the same gate."""
+
+    def __init__(self, up_after: int = 2, down_after: int = 2,
+                 healthy: bool = True):
+        self.healthy = healthy
+        self.up_after = max(1, int(up_after))
+        self.down_after = max(1, int(down_after))
+        self._good = 0
+        self._bad = 0
+
+    def observe(self, ok: bool) -> bool:
+        """Feed one observation; returns True when the state FLIPPED."""
+        if ok:
+            self._good += 1
+            self._bad = 0
+            if not self.healthy and self._good >= self.up_after:
+                self.healthy = True
+                return True
+        else:
+            self._bad += 1
+            self._good = 0
+            if self.healthy and self._bad >= self.down_after:
+                self.healthy = False
+                return True
+        return False
+
+
+class Replica:
+    def __init__(self, name: str, base_url: str, up_after: int,
+                 down_after: int):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.gate = HealthGate(up_after=up_after, down_after=down_after)
+        self.not_before = 0.0  # Retry-After cooldown (router clock)
+        self.last_probe: dict | None = None
+
+
+def _urllib_transport(
+    method: str, url: str, body: bytes | None, headers: dict[str, str],
+    timeout_s: float,
+) -> tuple[int, dict[str, str], bytes]:
+    """Default transport: (status, headers, body). HTTP error statuses are
+    RETURNED (they are answers); transport-level failures raise — a
+    TimeoutError when the attempt's time budget ran out (the REPLICA may be
+    fine, the budget wasn't), a ConnectionError for everything that means
+    the replica is unreachable (the failover + health-gate signal)."""
+    import socket
+
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), err.read()
+    except socket.timeout as err:  # raised mid-read (body stalled)
+        raise TimeoutError(str(err)) from err
+    except urllib.error.URLError as err:
+        if isinstance(err.reason, (socket.timeout, TimeoutError)):
+            raise TimeoutError(str(err.reason)) from err
+        # unwrap to a transport failure the forward loop can failover on
+        raise ConnectionError(str(err.reason)) from err
+    except http.client.HTTPException as err:
+        # a replica dying MID-RESPONSE (IncompleteRead after headers,
+        # BadStatusLine on a half-written status) is a connect-class
+        # failure for the router — it must fail over + feed the health
+        # gate, not escape as a router 500. (RemoteDisconnected happens to
+        # be a ConnectionResetError too, but its siblings are not OSError.)
+        raise ConnectionError(f"{type(err).__name__}: {err}") from err
+
+
+class FleetMetrics:
+    """mine_fleet_* families on the shared registry."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self.requests = r.counter(
+            "mine_fleet_requests_total",
+            "router responses by endpoint and status code",
+        )
+        self.request_latency = r.histogram(
+            "mine_fleet_request_latency_seconds",
+            "router-side request wall time by endpoint",
+        )
+        self.routed = r.counter(
+            "mine_fleet_routed_total",
+            "upstream dispatches by replica (first attempts + failovers)",
+        )
+        self.failovers = r.counter(
+            "mine_fleet_failovers_total",
+            "attempts abandoned for the next candidate, by reason "
+            "(connect_error|unavailable_503|attempt_timeout)",
+        )
+        self.no_replica = r.counter(
+            "mine_fleet_no_replica_total",
+            "requests answered 503 because every candidate was "
+            "down/cooling/exhausted",
+        )
+        self.replica_up = r.gauge(
+            "mine_fleet_replica_up",
+            "health-gated ring membership by replica (1 in, 0 out)",
+        )
+        self.ring_size = r.gauge(
+            "mine_fleet_ring_size", "replicas currently in the ring",
+        )
+        self.ring_transitions = r.counter(
+            "mine_fleet_ring_transitions_total",
+            "hysteresis state flips by replica and direction (to=up|down)",
+        )
+        self.probes = r.counter(
+            "mine_fleet_probes_total",
+            "health probes by replica and outcome (ok|fail)",
+        )
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
+class FleetApp:
+    """Routing + health state for one fleet; transport and clock are
+    injectable so the state machines are unit-testable without sockets."""
+
+    def __init__(
+        self,
+        replicas: dict[str, str] | list[str],
+        probe_interval_s: float = 2.0,
+        probe_timeout_s: float = 2.0,
+        up_after: int = 2,
+        down_after: int = 2,
+        max_attempts: int = 3,
+        deadline_s: float = 30.0,
+        retry_after_s: float = 1.0,
+        vnodes: int = 64,
+        metrics: FleetMetrics | None = None,
+        transport: Callable | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if isinstance(replicas, list):
+            replicas = {f"r{i}": url for i, url in enumerate(replicas)}
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.metrics = metrics if metrics is not None else FleetMetrics()
+        self.replicas = {
+            name: Replica(name, url, up_after, down_after)
+            for name, url in replicas.items()
+        }
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.max_attempts = max(1, int(max_attempts))
+        self.deadline_s = float(deadline_s)
+        self.retry_after_s = float(retry_after_s)
+        self.vnodes = vnodes
+        self.transport = transport if transport is not None else _urllib_transport
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._ring = HashRing(list(self.replicas), vnodes=vnodes)
+        self._probe_stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self._started_at = time.time()
+        for name in self.replicas:
+            self.metrics.replica_up.set(1, replica=name)
+        self.metrics.ring_size.set(len(self.replicas))
+
+    # -- ring membership -------------------------------------------------------
+
+    def ring_members(self) -> list[str]:
+        with self._lock:
+            return list(self._ring.members)
+
+    def _observe(self, replica: Replica, ok: bool) -> None:
+        """Feed one health observation (probe or request-path); rebuild the
+        ring on a hysteresis flip."""
+        with self._lock:
+            flipped = replica.gate.observe(ok)
+            if flipped:
+                members = [r.name for r in self.replicas.values()
+                           if r.gate.healthy]
+                self._ring = HashRing(members, vnodes=self.vnodes)
+                self.metrics.replica_up.set(
+                    1 if replica.gate.healthy else 0, replica=replica.name
+                )
+                self.metrics.ring_size.set(len(members))
+                self.metrics.ring_transitions.inc(
+                    replica=replica.name,
+                    to="up" if replica.gate.healthy else "down",
+                )
+
+    def probe_once(self) -> dict[str, bool]:
+        """One /healthz sweep over every replica (in or out of the ring —
+        ejected replicas must keep being probed to ever rejoin)."""
+        results: dict[str, bool] = {}
+        for replica in list(self.replicas.values()):
+            try:
+                status, _, body = self.transport(
+                    "GET", replica.base_url + "/healthz", None, {},
+                    self.probe_timeout_s,
+                )
+                ok = status == 200
+                replica.last_probe = {"status": status}
+                try:
+                    replica.last_probe.update(json.loads(body))
+                except ValueError:
+                    pass
+            except Exception as exc:  # noqa: BLE001 - a probe may die anyhow
+                ok = False
+                replica.last_probe = {"error": f"{type(exc).__name__}: {exc}"}
+            self.metrics.probes.inc(replica=replica.name,
+                                    outcome="ok" if ok else "fail")
+            self._observe(replica, ok)
+            results[replica.name] = ok
+        return results
+
+    def start(self) -> "FleetApp":
+        if self._probe_thread is None:
+            def loop():
+                while not self._probe_stop.wait(self.probe_interval_s):
+                    self.probe_once()
+
+            self._probe_thread = threading.Thread(
+                target=loop, name="mine-fleet-probe", daemon=True
+            )
+            self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+
+    # -- forwarding ------------------------------------------------------------
+
+    def candidates_for(self, digest: str) -> list[Replica]:
+        with self._lock:
+            names = self._ring.candidates(digest)
+        return [self.replicas[n] for n in names]
+
+    def forward(
+        self,
+        digest: str,
+        method: str,
+        path: str,
+        body: bytes | None,
+        headers: dict[str, str],
+        timeout_s: float | None = None,
+    ) -> tuple[int, dict[str, str], bytes, str]:
+        """Route one request by digest with bounded failover.
+
+        Walks the ring's candidate order (owner first), skipping replicas
+        inside a Retry-After cooldown. Each attempt gets the REMAINING
+        deadline budget. Failover happens on transport errors and 503s
+        (the replica is shedding — its Retry-After opens the cooldown);
+        every other status, including 404/504/500, is the replica's honest
+        ANSWER and passes through (re-dispatching a 404 elsewhere cannot
+        find an MPI that only the owner would have had).
+
+        Returns (status, headers, body, replica_name). Raises
+        NoHealthyReplica (-> 503) or FleetDeadlineExceeded (-> 504).
+        """
+        deadline = self.clock() + (
+            timeout_s if timeout_s and timeout_s > 0 else self.deadline_s
+        )
+        candidates = self.candidates_for(digest)
+        if not candidates:
+            self.metrics.no_replica.inc()
+            raise NoHealthyReplica(self.retry_after_s)
+        min_cooldown = None
+        attempts = 0
+        for replica in candidates:
+            if attempts >= self.max_attempts:
+                break
+            now = self.clock()
+            if replica.not_before > now:
+                min_cooldown = (replica.not_before - now
+                                if min_cooldown is None
+                                else min(min_cooldown,
+                                         replica.not_before - now))
+                continue
+            remaining = deadline - now
+            if remaining <= 0:
+                raise FleetDeadlineExceeded(
+                    f"deadline expired after {attempts} attempt(s)"
+                )
+            attempts += 1
+            self.metrics.routed.inc(replica=replica.name)
+            try:
+                status, resp_headers, resp_body = self.transport(
+                    method, replica.base_url + path, body, headers, remaining
+                )
+            except TimeoutError:
+                # the ATTEMPT's budget ran out, not necessarily the
+                # replica: a busy-but-healthy replica under an impatient
+                # client deadline must NOT be ejected (losing its arc
+                # cold-misses its whole MPI cache) — the probe loop, with
+                # its own timeout, is the judge of replica health. Fail
+                # over with whatever budget remains. (TimeoutError is an
+                # OSError subclass — this clause must come first.)
+                self.metrics.failovers.inc(reason="attempt_timeout")
+                continue
+            except (ConnectionError, OSError):
+                # transport failure: feed the hysteresis gate (2 of these
+                # eject the replica without waiting for the probe loop) and
+                # fail over
+                self._observe(replica, False)
+                self.metrics.failovers.inc(reason="connect_error")
+                continue
+            if status == 503:
+                # the replica is shedding (queue full / breaker open /
+                # draining): honor its Retry-After as a cooldown so the
+                # ring does not hammer a replica that asked for air.
+                # Deliberately NEUTRAL for the health gate — neither a
+                # connect failure nor a success that could mask the probe
+                # loop's degraded verdict (the probe reads /healthz 503
+                # as down; a render 503 must not keep resetting that).
+                retry_after = _parse_retry_after(resp_headers)
+                replica.not_before = self.clock() + retry_after
+                min_cooldown = (retry_after if min_cooldown is None
+                                else min(min_cooldown, retry_after))
+                self.metrics.failovers.inc(reason="unavailable_503")
+                continue
+            # any other answered request is evidence of life: reset the
+            # gate's failure streak so two SPORADIC connect errors with
+            # hundreds of successes in between cannot eject the replica
+            # (the hysteresis contract is about consecutive signal)
+            self._observe(replica, True)
+            return status, resp_headers, resp_body, replica.name
+        if self.clock() >= deadline:
+            raise FleetDeadlineExceeded(
+                f"deadline expired after {attempts} attempt(s)"
+            )
+        self.metrics.no_replica.inc()
+        raise NoHealthyReplica(
+            min_cooldown if min_cooldown is not None else self.retry_after_s
+        )
+
+    # -- fleet-wide operations -------------------------------------------------
+
+    def health(self) -> dict:
+        members = self.ring_members()
+        return {
+            "status": "ok" if members else "degraded",
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "ring_size": len(members),
+            "replicas": {
+                r.name: {
+                    "base_url": r.base_url,
+                    "in_ring": r.gate.healthy,
+                    "last_probe": r.last_probe,
+                }
+                for r in self.replicas.values()
+            },
+        }
+
+    def swap_all(self, wait: bool = True,
+                 timeout_s: float = 600.0) -> dict[str, dict]:
+        """Fan POST /admin/swap out to EVERY configured replica
+        (sequentially: a rolling upgrade — at most one replica is warming a
+        generation at a time, the rest serve). Deliberately not limited to
+        ring members: a replica the health gate has temporarily ejected
+        (shedding under load) would otherwise rejoin serving STALE weights
+        with nothing to reconcile it — an unreachable replica simply
+        reports its transport error. Returns per-replica outcomes, each
+        tagged `in_ring`; a replica "succeeded" only when its swap status
+        says so (state ok/noop), never on a bare 202 (a refused concurrent
+        swap also answers in_progress)."""
+        payload = json.dumps({"wait": wait}).encode()
+        results: dict[str, dict] = {}
+        in_ring = set(self.ring_members())
+        for name, replica in self.replicas.items():
+            try:
+                status, _, body = self.transport(
+                    "POST", replica.base_url + "/admin/swap", payload,
+                    {"Content-Type": "application/json"}, timeout_s,
+                )
+                try:
+                    results[name] = {"status": status, **json.loads(body)}
+                except ValueError:
+                    results[name] = {"status": status}
+            except Exception as exc:  # noqa: BLE001 - per-replica verdicts
+                results[name] = {"error": f"{type(exc).__name__}: {exc}"}
+            results[name]["in_ring"] = name in in_ring
+        return results
+
+
+def _parse_retry_after(headers: dict[str, str]) -> float:
+    for key, value in headers.items():
+        if key.lower() == "retry-after":
+            try:
+                return max(0.1, float(value))
+            except ValueError:
+                break
+    return 1.0
+
+
+def digest_of_request(path: str, body: bytes,
+                      content_type: str) -> tuple[str, float | None]:
+    """(routing digest, body-declared timeout_s) for one fleet request.
+
+    /predict: sha256 of the IMAGE BYTES — the same digest the replica
+    computes for its cache key, so the ring sends repeats of one image to
+    one replica. /render: the digest component of the mpi_key (minted by a
+    /predict this router routed, so it lands on the replica holding the
+    MPI)."""
+    if path == "/predict":
+        if content_type == "application/json":
+            req = json.loads(body)
+            import base64
+
+            image_bytes = base64.b64decode(req["image_b64"])
+            return (hashlib.sha256(image_bytes).hexdigest(),
+                    _float_or_none(req.get("timeout_s")))
+        return hashlib.sha256(body).hexdigest(), None
+    if path == "/render":
+        req = json.loads(body)
+        digest = str(req["mpi_key"]).split(":", 1)[0]
+        return digest, _float_or_none(req.get("timeout_s"))
+    raise ValueError(f"unroutable path {path}")
+
+
+def _float_or_none(v: Any) -> float | None:
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    server: "FleetHTTPServer"
+    protocol_version = "HTTP/1.1"
+
+    _FORWARD_HEADERS = ("Content-Type", "X-Request-Id")
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, payload: bytes, content_type: str,
+              extra: dict[str, str] | None = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_json(self, code: int, obj: dict,
+                   extra: dict[str, str] | None = None) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json", extra)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _route(self, method: str, path: str) -> tuple[int, str]:
+        app = self.server.app
+        if method == "GET" and path == "/healthz":
+            health = app.health()
+            code = 200 if health["status"] == "ok" else 503
+            self._send_json(code, health)
+            return code, "healthz"
+        if method == "GET" and path == "/metrics":
+            self._send(200, app.metrics.render().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+            return 200, "metrics"
+        if method == "POST" and path == "/admin/swap":
+            body = self._read_body()
+            wait = True
+            try:
+                if body:
+                    wait = bool(json.loads(body).get("wait", True))
+            except ValueError:
+                pass
+            results = app.swap_all(wait=wait)
+            # with wait (the default), success means the swap RESOLVED on
+            # every in-ring replica — a 202/in_progress is not a flip.
+            # Out-of-ring replicas are best-effort (reported, not gating):
+            # an unreachable one cannot fail a fleet upgrade it never saw.
+            done_states = ("ok", "noop") if wait else ("ok", "noop",
+                                                       "in_progress")
+            ok = all(
+                r.get("state") in done_states
+                for r in results.values() if r.get("in_ring")
+            )
+            self._send_json(200 if ok else 422, {"replicas": results})
+            return 200 if ok else 422, "admin_swap"
+        if method == "POST" and path in ("/predict", "/render"):
+            return self._forward(app, path), path.lstrip("/")
+        self._send_json(404, {"error": f"no route {method} {path}"})
+        return 404, "unknown"
+
+    def _forward(self, app: FleetApp, path: str) -> int:
+        body = self._read_body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        try:
+            digest, timeout_s = digest_of_request(path, body, ctype)
+        except (ValueError, KeyError, TypeError) as exc:
+            self._send_json(400, {"error": f"unroutable request: {exc}"})
+            return 400
+        headers = {
+            k: self.headers[k] for k in self._FORWARD_HEADERS
+            if self.headers.get(k)
+        }
+        try:
+            status, resp_headers, resp_body, replica = app.forward(
+                digest, "POST", path, body, headers, timeout_s=timeout_s
+            )
+        except NoHealthyReplica as exc:
+            retry_after = max(exc.retry_after_s, 0.1)
+            self._send_json(
+                503, {"error": str(exc), "retry_after_s": retry_after},
+                {"Retry-After": f"{retry_after:.1f}"},
+            )
+            return 503
+        except FleetDeadlineExceeded as exc:
+            self._send_json(504, {"error": str(exc)})
+            return 504
+        extra = {"X-Mine-Replica": replica}
+        for k, v in resp_headers.items():
+            if k.lower() in ("retry-after", "x-request-id"):
+                extra[k] = v
+        self._send(status, resp_body,
+                   resp_headers.get("Content-Type", "application/json"),
+                   extra)
+        return status
+
+    def _handle(self, method: str) -> None:
+        app = self.server.app
+        path = self.path.split("?", 1)[0]
+        t0 = time.monotonic()
+        try:
+            code, endpoint = self._route(method, path)
+        except (BrokenPipeError, ConnectionResetError):
+            raise
+        except Exception as exc:  # noqa: BLE001 - HTTP boundary
+            code, endpoint = 500, path.lstrip("/") or "unknown"
+            try:
+                self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            except Exception:  # noqa: BLE001 - client already gone
+                pass
+        app.metrics.requests.inc(endpoint=endpoint, status=str(code))
+        app.metrics.request_latency.observe(
+            time.monotonic() - t0, endpoint=endpoint
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        self._handle("GET")
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        self._handle("POST")
+
+
+class FleetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr: tuple[str, int], app: FleetApp,
+                 verbose: bool = False):
+        super().__init__(addr, _FleetHandler)
+        self.app = app
+        self.verbose = verbose
+
+
+def make_fleet_server(
+    app: FleetApp, host: str = "127.0.0.1", port: int = 0,
+    verbose: bool = False,
+) -> FleetHTTPServer:
+    return FleetHTTPServer((host, port), app, verbose=verbose)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--replica", action="append", default=[], metavar="URL",
+        required=False,
+        help="replica base URL (repeatable), e.g. http://10.0.0.5:8000",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8800)
+    parser.add_argument("--probe-interval", type=float, default=2.0)
+    parser.add_argument("--max-attempts", type=int, default=3)
+    parser.add_argument("--deadline", type=float, default=30.0)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    if not args.replica:
+        parser.error("at least one --replica URL is required")
+    app = FleetApp(
+        list(args.replica), probe_interval_s=args.probe_interval,
+        max_attempts=args.max_attempts, deadline_s=args.deadline,
+    ).start()
+    server = make_fleet_server(app, args.host, args.port,
+                               verbose=args.verbose)
+    host, port = server.server_address[:2]
+    print(f"fleet router over {len(args.replica)} replicas on "
+          f"http://{host}:{port} (/predict /render /healthz /metrics "
+          f"/admin/swap)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        app.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,26 @@ class ServingMetrics:
             "breaker's input signal",
         )
 
+        # hot checkpoint swap (serving/engine.py swap_weights + the
+        # ServingApp swap worker): generation flips and the named failure
+        # modes. A failed swap is NEVER a 5xx — it is these counters.
+        self.weight_generation = r.gauge(
+            "mine_serve_weight_generation",
+            "serving weight generation (0 = the startup checkpoint; "
+            "incremented by every successful hot swap)",
+        )
+        self.swaps = r.counter(
+            "mine_serve_swaps_total",
+            "successful hot checkpoint swaps (atomic generation flips)",
+        )
+        self.swap_failures = r.counter(
+            "mine_serve_swap_failures_total",
+            "hot swaps that did NOT flip, by reason (load = checkpoint "
+            "unreadable/corrupt; rejected = tree/shape validation or "
+            "verification dispatch failed; in_progress = concurrent swap "
+            "refused) — the old generation kept serving in every case",
+        )
+
         # host-span tracing (obs/trace.py wired via ServingApp)
         self.trace_spans = r.counter(
             "mine_serve_trace_spans_total",

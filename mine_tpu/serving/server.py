@@ -17,8 +17,19 @@ Endpoints:
                   -> {"frames_png_b64": [...], ...}. 404 when the MPI fell
                   out of the cache (client re-predicts). Concurrent renders
                   of one MPI coalesce into one dispatch (batcher.py).
-  GET  /healthz   liveness + engine/bucket/cache snapshot.
+  GET  /healthz   liveness + engine/bucket/cache snapshot (including the
+                  serving weight generation + swap state).
   GET  /metrics   Prometheus text exposition (serving/metrics.py names).
+  POST /admin/swap  hot checkpoint swap (serving/engine.py swap_weights):
+                  reload the workspace's newest checkpoint into a NEW
+                  weight generation, validate/verify it against the
+                  serving tree, atomically flip. 202 async (default),
+                  {"wait": true} blocks; a rejected/corrupt swap answers
+                  422 with the named error and the OLD generation keeps
+                  serving — never a 5xx. GET returns the last status.
+                  --watch-last-good N polls the training job's last_good
+                  pointer and promotes newer vetted checkpoints
+                  automatically.
   GET  /debug/trace  the request-lifecycle host spans (parse, queue-wait,
                   coalesce, dispatch, encode — obs/trace.py) as
                   Chrome-trace JSON: drop it into chrome://tracing, or
@@ -63,7 +74,7 @@ import numpy as np
 from mine_tpu.config import Config
 from mine_tpu.obs.memlog import MemLog
 from mine_tpu.obs.trace import Tracer
-from mine_tpu.resilience import BreakerOpen, CircuitBreaker
+from mine_tpu.resilience import BreakerOpen, CircuitBreaker, chaos
 from mine_tpu.serving.batcher import (
     BatcherStopped,
     DeadlineExceeded,
@@ -71,7 +82,13 @@ from mine_tpu.serving.batcher import (
     QueueFull,
 )
 from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
-from mine_tpu.serving.engine import BucketSpec, RenderEngine
+from mine_tpu.serving.engine import (
+    BucketSpec,
+    RenderEngine,
+    SwapError,
+    SwapInProgress,
+    SwapRejected,
+)
 from mine_tpu.serving.metrics import ServingMetrics
 
 
@@ -124,8 +141,8 @@ class ServingApp:
     def __init__(
         self,
         cfg: Config,
-        params: Any,
-        batch_stats: Any,
+        params: Any = None,
+        batch_stats: Any = None,
         checkpoint_step: int = 0,
         cache_bytes: int = 2 << 30,
         max_delay_ms: float = 4.0,
@@ -142,6 +159,8 @@ class ServingApp:
         retry_after_s: float | None = None,
         breaker_failure_threshold: int | None = None,
         breaker_reset_s: float | None = None,
+        engine: RenderEngine | None = None,
+        swap_source: Any = None,
     ):
         res = cfg.resilience  # ctor args override the resilience.* knobs
 
@@ -177,12 +196,35 @@ class ServingApp:
             live_gauge=self.metrics.hbm_live_bytes,
             peak_gauge=self.metrics.hbm_peak_bytes,
         )
-        self.engine = RenderEngine(
-            cfg, params, batch_stats, checkpoint_step=checkpoint_step,
-            metrics=self.metrics, fov_deg=fov_deg,
-            peak_flops_override=peak_flops_override,
-            tracer=self.tracer,
-        )
+        if engine is not None:
+            # a prebuilt engine (the fake one from serving/fake.py, or a
+            # caller-tuned real one) adopts this app's metrics + tracer so
+            # its dispatches land in the same registry and span ring
+            engine.metrics = self.metrics
+            engine.tracer = self.tracer
+            self.engine = engine
+        else:
+            self.engine = RenderEngine(
+                cfg, params, batch_stats, checkpoint_step=checkpoint_step,
+                metrics=self.metrics, fov_deg=fov_deg,
+                peak_flops_override=peak_flops_override,
+                tracer=self.tracer,
+            )
+        self.metrics.weight_generation.set(self.engine.generation)
+        # hot-swap source: a workspace path (str — the production shape:
+        # POST /admin/swap re-reads its newest checkpoint, validated
+        # against the serving tree) or a zero-arg callable returning
+        # (params, batch_stats, step) (tests, the chaos drill's fake
+        # fleet). None disables /admin/swap with a 400.
+        self.swap_source = swap_source
+        self._swap_lock = threading.Lock()
+        self._swap_thread: threading.Thread | None = None
+        self._swap_status: dict[str, Any] = {
+            "state": "idle", "generation": self.engine.generation,
+            "checkpoint_step": self.engine.checkpoint_step,
+        }
+        self._promote_stop = threading.Event()
+        self._promote_thread: threading.Thread | None = None
         # shapes an untrusted /predict body may request: each admitted spec
         # costs a full XLA compile + an O(S*H*W) resident MPI, so the set is
         # operator-configured, never client-grown (the compile-boundedness
@@ -231,6 +273,169 @@ class ServingApp:
     def _guarded_render(self, entry, poses):
         return self._breaker_guard("render", self.engine.render, entry, poses)
 
+    # -- hot checkpoint swap ---------------------------------------------------
+
+    def swap_status(self) -> dict:
+        with self._swap_lock:
+            return dict(self._swap_status)
+
+    def swap(self, wait: bool = False, step: int | None = None) -> dict:
+        """Trigger a hot checkpoint swap from `swap_source`.
+
+        Asynchronous by default (the production shape: POST /admin/swap
+        answers 202 immediately and the load/validate/verify/flip sequence
+        runs on a worker thread while the old generation serves). With
+        `wait`, blocks until the attempt resolves — the drill and tests
+        use this for deterministic assertions. `step` pins a workspace
+        source to a specific retained checkpoint (the promotion watch
+        passes the vetted step; manual /admin/swap takes the newest).
+        Returns the status dict; NEVER raises for a failed swap (the
+        failure is named in the status and counted in
+        mine_serve_swap_failures_total) — only for a missing swap_source
+        (ValueError: a config error, not a runtime fault)."""
+        if self.swap_source is None:
+            raise ValueError(
+                "no swap source configured (start the server with a "
+                "--workspace, or pass swap_source=)"
+            )
+        with self._swap_lock:
+            if self._swap_status.get("state") == "in_progress":
+                self.metrics.swap_failures.inc(reason="in_progress")
+                return dict(self._swap_status)
+            self._swap_status = {
+                "state": "in_progress",
+                "generation": self.engine.generation,
+                "checkpoint_step": self.engine.checkpoint_step,
+                "started_at": time.time(),
+            }
+            thread = threading.Thread(
+                target=self._run_swap, args=(step,), name="mine-swap",
+                daemon=True,
+            )
+            self._swap_thread = thread
+            thread.start()
+        if wait:
+            thread.join()
+        return self.swap_status()
+
+    def _load_swap_source(self, step: int | None = None):
+        """(params, batch_stats, step) from the configured source; the
+        corrupt-checkpoint chaos seam fires here (a ChaosFault stands in
+        for orbax choking on a truncated/corrupt file)."""
+        chaos.maybe_raise("corrupt_swap")  # fault seam (resilience/chaos.py)
+        if callable(self.swap_source):
+            return self.swap_source()
+        from mine_tpu.training.checkpoint import load_for_serving
+
+        import jax
+
+        expected = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self.engine.variables,
+        )
+        _, params, batch_stats, step = load_for_serving(
+            self.swap_source, expected_variables=expected, step=step
+        )
+        return params, batch_stats, step
+
+    def _run_swap(self, target_step: int | None = None) -> None:
+        # The status update is unconditional: if ANY exception escaped this
+        # worker, _swap_status would stay "in_progress" forever and every
+        # future swap (manual and promotion watch alike) would be refused —
+        # the swap subsystem must degrade to a named failure, never wedge.
+        try:
+            outcome = self._swap_attempt(target_step)
+        except Exception as exc:  # noqa: BLE001 - the never-wedge backstop
+            self.metrics.swap_failures.inc(reason="internal")
+            outcome = {"state": "failed", "reason": "internal",
+                       "error": f"{type(exc).__name__}: {exc}"}
+        with self._swap_lock:
+            started = self._swap_status.get("started_at")
+            self._swap_status = {
+                **outcome,
+                "generation": self.engine.generation,
+                "checkpoint_step": self.engine.checkpoint_step,
+                "duration_s": (round(time.time() - started, 3)
+                               if started else None),
+            }
+
+    def _swap_attempt(self, target_step: int | None) -> dict[str, Any]:
+        try:
+            params, batch_stats, step = self._load_swap_source(target_step)
+        except Exception as exc:  # noqa: BLE001 - named, counted, no 5xx
+            self.metrics.swap_failures.inc(reason="load")
+            return {"state": "failed", "reason": "load",
+                    "error": f"{type(exc).__name__}: {exc}"}
+        if int(step) == self.engine.checkpoint_step:
+            return {"state": "noop", "note": f"already serving step {step}"}
+        try:
+            ws = self.engine.swap_weights(params, batch_stats, step)
+        except SwapInProgress as exc:
+            self.metrics.swap_failures.inc(reason="in_progress")
+            return {"state": "failed", "reason": "in_progress",
+                    "error": str(exc)}
+        except SwapError as exc:
+            self.metrics.swap_failures.inc(reason="rejected")
+            return {"state": "failed", "reason": "rejected",
+                    "error": f"{type(exc).__name__}: {exc}"}
+        # a non-SwapError out of swap_weights (a device OOM placing the
+        # candidate, a racing bucket compile failure) is caught by the
+        # _run_swap backstop: reason "internal", old generation serving
+        self.metrics.swaps.inc()
+        return {"state": "ok", "swapped_to_step": ws.checkpoint_step}
+
+    def maybe_promote(self) -> dict | None:
+        """One promotion check: when the training job's last_good pointer
+        (workspace sidecar, training/checkpoint.py) vets a step newer than
+        the serving generation, swap to the newest RETAINED step at or
+        under the pointer — never to a fresher, not-yet-vetted checkpoint
+        (the whole point of watching last_good instead of latest; the
+        sentinel may be about to roll the newest one back). A pointer
+        whose vetted steps were all GC'd resolves to nothing newer and is
+        a quiet no-op — not an endless restore-and-noop loop. Returns the
+        swap status when one was triggered, None otherwise. Only
+        meaningful for a workspace-path swap_source."""
+        if not isinstance(self.swap_source, str):
+            return None
+        from mine_tpu.training.checkpoint import (
+            checkpoint_manager,
+            last_good_step,
+        )
+
+        pointer = last_good_step(self.swap_source)
+        if pointer is None or pointer <= self.engine.checkpoint_step:
+            return None
+        vetted = [
+            int(s) for s in checkpoint_manager(self.swap_source).all_steps()
+            if int(s) <= pointer
+        ]
+        target = max(vetted) if vetted else None
+        if target is None or target <= self.engine.checkpoint_step:
+            return None
+        if self.swap_status().get("state") == "in_progress":
+            return None
+        return self.swap(wait=True, step=target)
+
+    def start_promotion_watch(self, interval_s: float = 30.0) -> None:
+        """Poll the last_good pointer on a daemon thread: a training job
+        continuously promotes vetted weights into the live server
+        (--watch-last-good). Idempotent; stopped by close()."""
+        if self._promote_thread is not None:
+            return
+
+        def watch():
+            while not self._promote_stop.wait(interval_s):
+                try:
+                    self.maybe_promote()
+                except Exception as exc:  # noqa: BLE001 - keep watching
+                    print(f"# last_good promotion check failed: {exc}",
+                          file=__import__("sys").stderr)
+
+        self._promote_thread = threading.Thread(
+            target=watch, name="mine-last-good-watch", daemon=True
+        )
+        self._promote_thread.start()
+
     def predict(
         self, image_bytes: bytes, spec: BucketSpec | None = None,
         request_id: str | None = None,
@@ -245,7 +450,11 @@ class ServingApp:
                     "(extend with --bucket H,W,S at server start)"
                 )
         bucket = self.engine.bucket(spec)  # validates the requested shape
-        key = mpi_key(digest, self.engine.checkpoint_step, bucket.spec)
+        # ONE weights snapshot keys the cache AND runs the dispatch: reading
+        # checkpoint_step and variables separately could straddle a hot swap
+        # and file a new-generation MPI under the old generation's key
+        weights = self.engine.weights()
+        key = mpi_key(digest, weights.checkpoint_step, bucket.spec)
 
         def response(entry, cached: bool) -> dict:
             return {
@@ -291,7 +500,7 @@ class ServingApp:
             image = _decode_image(image_bytes)
             entry = self._breaker_guard(
                 "predict", self.engine.predict, image, bucket.spec,
-                request_id,
+                request_id, weights,
             )
             self.cache.put(key, entry)
             future.set_result(entry)
@@ -379,6 +588,8 @@ class ServingApp:
             "uptime_s": round(time.time() - self._started_at, 1),
             "backend": jax.default_backend(),
             "checkpoint_step": self.engine.checkpoint_step,
+            "weight_generation": self.engine.generation,
+            "swap_state": self.swap_status().get("state", "idle"),
             "buckets": [list(s) for s in self.engine.bucket_specs()],
             "compiles": self.engine.compiles,
             "cache_entries": len(self.cache),
@@ -392,6 +603,9 @@ class ServingApp:
         }
 
     def close(self) -> None:
+        self._promote_stop.set()
+        if self._promote_thread is not None:
+            self._promote_thread.join(timeout=5)
         self.batcher.stop()
 
 
@@ -510,6 +724,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._predict(app), "predict"
         if method == "POST" and path == "/render":
             return self._render(app), "render"
+        if method == "GET" and path == "/admin/swap":
+            self._send_json(200, app.swap_status())
+            return 200, "admin_swap"
+        if method == "POST" and path == "/admin/swap":
+            return self._admin_swap(app), "admin_swap"
         self._send_json(404, {"error": f"no route {method} {path}"})
         return 404, "unknown"
 
@@ -530,6 +749,23 @@ class _Handler(BaseHTTPRequestHandler):
         app = self.server.app
         path = self.path.split("?", 1)[0]
         self.request_id = self._resolve_request_id()
+        if chaos.should("replica_kill"):  # fault seam (resilience/chaos.py)
+            # replica death, as a fleet router sees it: the listener goes
+            # away and the triggering connection drops with NO response —
+            # not a clean 5xx. shutdown() must run off-thread (it joins the
+            # serve_forever loop this handler is running under).
+            def die(srv):
+                srv.shutdown()
+                srv.server_close()
+
+            threading.Thread(target=die, args=(self.server,),
+                             daemon=True).start()
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except OSError:
+                pass
+            return
         t0 = time.monotonic()
         try:
             code, endpoint = self._route(method, path)
@@ -594,6 +830,38 @@ class _Handler(BaseHTTPRequestHandler):
             return 400
         self._send_json(200, result)
         return 200
+
+    def _admin_swap(self, app: ServingApp) -> int:
+        """Trigger a hot checkpoint swap. 202 + status for an accepted
+        async swap; body {"wait": true} blocks until the attempt resolves
+        (200 on flip/noop, 409 when another swap is running, 422 for a
+        named rejection/load failure). A failed swap is NEVER a 5xx: the
+        old generation is still serving, which is the opposite of a server
+        error."""
+        try:
+            body = self._read_body()
+            req = json.loads(body) if body else {}
+            if not isinstance(req, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad swap body: {exc}"})
+            return 400
+        wait = bool(req.get("wait"))
+        try:
+            status = app.swap(wait=wait)
+        except ValueError as exc:  # no swap source configured
+            self._send_json(400, {"error": str(exc)})
+            return 400
+        # with wait, an "in_progress" answer can only mean ANOTHER swap
+        # holds the slot (a freshly accepted one would have been joined to
+        # completion) — that is a refusal, not an acceptance: 409
+        code = {
+            "ok": 200, "noop": 200, "idle": 200,
+            "in_progress": 409 if wait else 202,
+            "failed": 409 if status.get("reason") == "in_progress" else 422,
+        }.get(status.get("state"), 200)
+        self._send_json(code, status)
+        return code
 
     def _render(self, app: ServingApp) -> int:
         rid = self.request_id
@@ -709,6 +977,12 @@ def main(argv: list[str] | None = None) -> None:
         "empty trace; the trace-counter metric family stays at 0)",
     )
     parser.add_argument(
+        "--watch-last-good", type=float, default=0.0, metavar="SECS",
+        help="poll the workspace's last_good pointer every SECS seconds "
+        "and hot-swap to newer vetted checkpoints (0 disables; "
+        "POST /admin/swap always works regardless)",
+    )
+    parser.add_argument(
         "--peak-flops", type=float, default=0.0,
         help="peak FLOP/s for the MFU gauge when the device kind has no "
         "published table entry (obs/cost.py) — e.g. a CPU smoke",
@@ -736,7 +1010,13 @@ def main(argv: list[str] | None = None) -> None:
         allowed_buckets=extra_buckets,
         trace_enabled=not args.no_trace,
         peak_flops_override=args.peak_flops,
+        swap_source=args.workspace,
     )
+    if args.watch_last_good > 0:
+        # a training job advancing the workspace's last_good pointer
+        # (resilience/preempt.py + sentinel vetting) continuously promotes
+        # vetted weights into this live server via the hot-swap path
+        app.start_promotion_watch(interval_s=args.watch_last_good)
     # flight recorder: SIGTERM/SIGUSR1 dump thread stacks + the last-K
     # request spans to the workspace sidecar (no stall watchdog here — an
     # idle server is healthy, unlike a training step that stopped)

@@ -222,10 +222,66 @@ def restore_last_good(
     return state, step
 
 
+class CheckpointTreeMismatch(ValueError):
+    """A restored checkpoint's param tree does not match the structure/
+    shapes the consumer expects. The NAMED swap-rejection error: before
+    this existed a bad checkpoint surfaced as an opaque XLA compile or
+    dispatch failure deep inside the first predict; now the first
+    mismatched leaf is named at load time and the caller (the engine's
+    hot-swap path) can roll back to the serving generation."""
+
+    def __init__(self, context: str, problems: list[str]):
+        self.problems = problems
+        shown = "; ".join(problems[:5])
+        more = f" (+{len(problems) - 5} more)" if len(problems) > 5 else ""
+        super().__init__(f"{context}: {shown}{more}")
+
+
+def _tree_signature(tree: Any) -> dict[str, tuple]:
+    """'/'-joined leaf path -> (shape, dtype) for a pytree of arrays."""
+    import jax
+
+    sig: dict[str, tuple] = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        name = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        sig[name] = (shape, dtype)
+    return sig
+
+
+def validate_variables_tree(
+    expected: Any, got: Any, context: str = "restored checkpoint"
+) -> None:
+    """Raise CheckpointTreeMismatch unless `got` carries exactly the leaf
+    paths of `expected` with matching shapes and dtypes. `expected` may be
+    a tree of real arrays or of jax.ShapeDtypeStruct — only shape/dtype
+    are read. Value content is deliberately NOT inspected: weights are
+    opaque, layout is the contract."""
+    want, have = _tree_signature(expected), _tree_signature(got)
+    problems: list[str] = []
+    for name in sorted(set(want) - set(have)):
+        problems.append(f"missing leaf {name} {want[name][0]}")
+    for name in sorted(set(have) - set(want)):
+        problems.append(f"unexpected leaf {name} {have[name][0]}")
+    for name in sorted(set(want) & set(have)):
+        if want[name] != have[name]:
+            problems.append(
+                f"leaf {name}: expected {want[name][0]}/{want[name][1]}, "
+                f"got {have[name][0]}/{have[name][1]}"
+            )
+    if problems:
+        raise CheckpointTreeMismatch(context, problems)
+
+
 def load_for_serving(
     workspace: str,
     overrides: str | None = None,
     allow_random_init: bool = False,
+    expected_variables: Any | None = None,
+    step: int | None = None,
 ) -> tuple[Config, Any, Any, int]:
     """Restore (cfg, params, batch_stats, step) for inference/serving.
 
@@ -238,10 +294,32 @@ def load_for_serving(
     Returns step = the checkpoint step served (0 with allow_random_init and
     no checkpoint — smoke runs only; the step is part of every MPI cache
     key, so serving a random init never aliases a trained model's cache).
+
+    `expected_variables` ({"params": ..., "batch_stats": ...}, arrays or
+    ShapeDtypeStructs) turns on tree validation: a restored tree whose
+    structure or leaf shapes diverge raises CheckpointTreeMismatch instead
+    of letting the mismatch surface later as an opaque compile/dispatch
+    failure. This is the hot-swap rejection path (serving/engine.py
+    swap_weights validates against the serving generation's tree).
+
+    `step` restores that specific retained step instead of the newest —
+    the last_good promotion watch passes the VETTED step so a freshly
+    written, not-yet-vetted checkpoint is never promoted into a live
+    server. An absent step raises FileNotFoundError (named, with the
+    retained set listed).
     """
     cfg = load_paired_config(workspace, overrides)
     manager = checkpoint_manager(workspace)
-    step = manager.latest_step()
+    if step is not None:
+        retained = sorted(int(s) for s in manager.all_steps())
+        if int(step) not in retained:
+            raise FileNotFoundError(
+                f"checkpoint step {step} not retained under "
+                f"{workspace}/checkpoints (retained: {retained})"
+            )
+        step = int(step)
+    else:
+        step = manager.latest_step()
     if step is None:
         if not allow_random_init:
             raise FileNotFoundError(
@@ -268,4 +346,25 @@ def load_for_serving(
     # StandardRestore arg matters — a fresh manager has no handler registered
     # for the saved item and a bare restore(step) raises)
     raw = manager.restore(step, args=ocp.args.StandardRestore())
-    return cfg, raw["params"], raw["batch_stats"], int(step)
+    missing = [
+        f"missing collection {name!r}"
+        for name in ("params", "batch_stats")
+        if not isinstance(raw, dict) or name not in raw
+    ]
+    if missing:
+        # a truncated/partial checkpoint must fail HERE with its collections
+        # named, not as a flax missing-collection error inside the first
+        # predict's compiled dispatch
+        raise CheckpointTreeMismatch(
+            f"checkpoint step {step} under {workspace}",
+            missing if isinstance(raw, dict) else
+            [f"restored object is not a state dict (got {type(raw).__name__})"],
+        )
+    params, batch_stats = raw["params"], raw["batch_stats"]
+    if expected_variables is not None:
+        validate_variables_tree(
+            expected_variables,
+            {"params": params, "batch_stats": batch_stats},
+            context=f"checkpoint step {step} under {workspace}",
+        )
+    return cfg, params, batch_stats, int(step)

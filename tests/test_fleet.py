@@ -1,0 +1,622 @@
+"""Fleet serving tests: consistent-hash ring, health-gate hysteresis,
+failover/deadline state machines (fake transport + injected clock), and the
+hot-swap state machine (FakeEngine — serving/fake.py) — zero XLA model
+compiles, breaker-test style (tests/test_resilience.py)."""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mine_tpu.resilience import chaos
+from mine_tpu.serving.fake import (
+    FakeEngine,
+    fake_checkpoint,
+    fake_variables,
+    make_fake_app,
+)
+from mine_tpu.serving.fleet import (
+    FleetApp,
+    FleetDeadlineExceeded,
+    HashRing,
+    HealthGate,
+    NoHealthyReplica,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _png(i: int = 0) -> bytes:
+    from PIL import Image
+
+    img = np.full((8, 8, 3), (i * 53) % 256, np.uint8)
+    img[0, 0] = (i % 256, 3, 9)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+# ------------------------------------------------------------- hash ring
+
+
+def test_hash_ring_candidates_deterministic_and_complete():
+    ring = HashRing(["a", "b", "c"])
+    for digest in ("x1", "x2", "deadbeef"):
+        cands = ring.candidates(digest)
+        assert sorted(cands) == ["a", "b", "c"]  # full failover order
+        assert cands == ring.candidates(digest)  # deterministic
+    assert HashRing(["c", "b", "a"]).candidates("x1") == ring.candidates("x1")
+
+
+def test_hash_ring_membership_change_remaps_only_lost_arc():
+    """Consistent hashing's point: removing one member must not move
+    digests between surviving members."""
+    full = HashRing(["a", "b", "c"])
+    reduced = HashRing(["a", "b"])
+    digests = [f"img{i}" for i in range(200)]
+    for d in digests:
+        before = full.candidates(d)[0]
+        after = reduced.candidates(d)[0]
+        if before != "c":
+            assert after == before, d
+        else:
+            assert after in ("a", "b")
+
+
+def test_hash_ring_rough_balance():
+    ring = HashRing(["a", "b", "c"])
+    owners = [ring.candidates(f"d{i}")[0] for i in range(300)]
+    for m in ("a", "b", "c"):
+        assert owners.count(m) >= 30  # no starved member (vnode smoothing)
+
+
+def test_hash_ring_empty():
+    assert HashRing([]).candidates("x") == []
+
+
+# ------------------------------------------------------- health hysteresis
+
+
+def test_health_gate_hysteresis_no_single_probe_flap():
+    gate = HealthGate(up_after=2, down_after=2)
+    assert not gate.observe(False) and gate.healthy  # one flake: no flap
+    assert not gate.observe(True) and gate.healthy   # streak reset
+    assert not gate.observe(False)
+    assert gate.observe(False) and not gate.healthy  # 2 consecutive: out
+    assert not gate.observe(True) and not gate.healthy
+    assert gate.observe(True) and gate.healthy       # 2 consecutive: back
+
+
+# -------------------------------------------- failover with fake transport
+
+
+class FakeTransport:
+    """Scripted per-URL-prefix responses + a call log. A behavior is a
+    (status, headers, body) tuple, an Exception to raise, or a callable."""
+
+    def __init__(self, behaviors):
+        self.behaviors = behaviors
+        self.calls: list[tuple[str, str, float]] = []
+
+    def __call__(self, method, url, body, headers, timeout_s):
+        for prefix, behavior in self.behaviors.items():
+            if url.startswith(prefix):
+                self.calls.append((method, url, timeout_s))
+                if callable(behavior):
+                    behavior = behavior(url)
+                if isinstance(behavior, Exception):
+                    raise behavior
+                return behavior
+        raise AssertionError(f"unscripted url {url}")
+
+
+def _fleet(behaviors, **kw):
+    transport = FakeTransport(behaviors)
+    kw.setdefault("probe_interval_s", 3600)  # probes only when told to
+    app = FleetApp({"r0": "http://r0", "r1": "http://r1"},
+                   transport=transport, **kw)
+    return app, transport
+
+
+OK = (200, {}, b'{"ok": true}')
+
+
+def test_forward_failover_on_connect_error_and_gate_ejection():
+    app, transport = _fleet({
+        "http://r0": ConnectionError("refused"),
+        "http://r1": OK,
+    }, down_after=2)
+    # force a digest owned by r0 so the failover path is exercised
+    digest = next(d for d in (f"d{i}" for i in range(50))
+                  if app.candidates_for(d)[0].name == "r0")
+    status, _, body, replica = app.forward(digest, "POST", "/render",
+                                           b"{}", {})
+    assert status == 200 and replica == "r1"
+    assert app.metrics.failovers.value(reason="connect_error") == 1
+    assert app.ring_members() == ["r0", "r1"]  # one error: still in (hysteresis)
+    app.forward(digest, "POST", "/render", b"{}", {})
+    assert app.ring_members() == ["r1"]  # second consecutive: ejected
+    assert app.metrics.replica_up.value(replica="r0") == 0
+    # ejected replica no longer offered traffic
+    transport.calls.clear()
+    app.forward(digest, "POST", "/render", b"{}", {})
+    assert all("r1" in url for _, url, _ in transport.calls)
+
+
+def test_forward_503_honors_retry_after_cooldown():
+    clock = {"t": 100.0}
+    app, transport = _fleet({
+        "http://r0": (503, {"Retry-After": "5"}, b"{}"),
+        "http://r1": OK,
+    }, clock=lambda: clock["t"])
+    digest = next(d for d in (f"d{i}" for i in range(50))
+                  if app.candidates_for(d)[0].name == "r0")
+    status, _, _, replica = app.forward(digest, "POST", "/render", b"{}", {})
+    assert status == 200 and replica == "r1"
+    assert app.metrics.failovers.value(reason="unavailable_503") == 1
+    # within the cooldown r0 is not even attempted
+    transport.calls.clear()
+    app.forward(digest, "POST", "/render", b"{}", {})
+    assert all("r1" in url for _, url, _ in transport.calls)
+    # a shedding 503 is NOT a health failure: the replica stays in the ring
+    assert app.ring_members() == ["r0", "r1"]
+    # past the cooldown it is offered again
+    clock["t"] += 6.0
+    transport.calls.clear()
+    app.forward(digest, "POST", "/render", b"{}", {})
+    assert any("r0" in url for _, url, _ in transport.calls)
+
+
+def test_forward_deadline_propagates_remaining_budget():
+    clock = {"t": 0.0}
+
+    def slow_then_refuse(url):
+        clock["t"] += 4.0  # the attempt burns 4s of budget
+        raise ConnectionError("slow death")
+
+    app, transport = _fleet({
+        "http://r0": slow_then_refuse,
+        "http://r1": slow_then_refuse,
+    }, clock=lambda: clock["t"], max_attempts=3)
+    with pytest.raises(FleetDeadlineExceeded):
+        app.forward("d0", "POST", "/render", b"{}", {}, timeout_s=6.0)
+    # first attempt got the full 6s budget, the second only the remainder
+    assert transport.calls[0][2] == pytest.approx(6.0)
+    assert transport.calls[1][2] == pytest.approx(2.0)
+
+
+def test_forward_passes_through_non_503_answers():
+    """404 (cache miss) and 500 are the replica's honest ANSWER — the
+    router must not shop them around (no other replica holds the MPI)."""
+    app, transport = _fleet({
+        "http://r0": (404, {}, b'{"error": "not cached"}'),
+        "http://r1": OK,
+    })
+    digest = next(d for d in (f"d{i}" for i in range(50))
+                  if app.candidates_for(d)[0].name == "r0")
+    status, _, _, replica = app.forward(digest, "POST", "/render", b"{}", {})
+    assert status == 404 and replica == "r0"
+    assert len(transport.calls) == 1
+
+
+def test_sporadic_connect_errors_do_not_eject_between_successes():
+    """The hysteresis contract is CONSECUTIVE signal: two transient
+    connect errors separated by successful answers must not eject a
+    replica (request-path successes reset the streak, not just probes)."""
+    flaky = {"fail_next": False}
+
+    def r0(url):
+        if flaky["fail_next"]:
+            flaky["fail_next"] = False
+            raise ConnectionError("blip")
+        return OK
+
+    app, _ = _fleet({"http://r0": r0, "http://r1": OK}, down_after=2)
+    digest = next(d for d in (f"d{i}" for i in range(50))
+                  if app.candidates_for(d)[0].name == "r0")
+    for _ in range(3):
+        flaky["fail_next"] = True
+        app.forward(digest, "POST", "/render", b"{}", {})  # error -> failover
+        app.forward(digest, "POST", "/render", b"{}", {})  # r0 success
+    assert app.ring_members() == ["r0", "r1"]  # 3 sporadic errors: still in
+    assert app.metrics.failovers.value(reason="connect_error") == 3
+
+
+def test_forward_attempt_timeout_does_not_eject_replica():
+    """A busy-but-healthy replica under an impatient client deadline must
+    fail over WITHOUT feeding the health gate — ejecting it would cold-miss
+    its whole cache arc exactly when it is most loaded."""
+    app, transport = _fleet({
+        "http://r0": TimeoutError("read timed out"),
+        "http://r1": OK,
+    }, down_after=2)
+    digest = next(d for d in (f"d{i}" for i in range(50))
+                  if app.candidates_for(d)[0].name == "r0")
+    for _ in range(4):
+        status, _, _, replica = app.forward(digest, "POST", "/render",
+                                            b"{}", {})
+        assert status == 200 and replica == "r1"
+    assert app.ring_members() == ["r0", "r1"]  # never ejected
+    assert app.metrics.failovers.value(reason="attempt_timeout") == 4
+    assert app.metrics.failovers.value(reason="connect_error") == 0
+
+
+def test_swap_all_reaches_ejected_replicas_too():
+    """The fan-out must include out-of-ring replicas: a temporarily
+    ejected replica that rejoined later would otherwise serve stale
+    weights with nothing to reconcile it."""
+    swap_ok = (200, {}, b'{"state": "ok"}')
+    app, transport = _fleet({"http://r0": swap_ok, "http://r1": swap_ok},
+                            down_after=1)
+    app._observe(app.replicas["r0"], False)  # eject r0
+    assert app.ring_members() == ["r1"]
+    results = app.swap_all(wait=True)
+    assert set(results) == {"r0", "r1"}
+    assert results["r0"]["in_ring"] is False
+    assert results["r1"]["in_ring"] is True
+    assert all(r["state"] == "ok" for r in results.values())
+
+
+def test_forward_all_down_raises_no_healthy_replica():
+    app, _ = _fleet({
+        "http://r0": ConnectionError("dead"),
+        "http://r1": ConnectionError("dead"),
+    }, max_attempts=3)
+    with pytest.raises(NoHealthyReplica):
+        app.forward("d0", "POST", "/render", b"{}", {})
+    assert app.metrics.no_replica.value() == 1
+
+
+def test_probe_once_gates_ring_and_aggregated_health():
+    state = {"r0_ok": True}
+    app, _ = _fleet({
+        "http://r0": lambda url: (
+            (200, {}, b'{"status": "ok"}') if state["r0_ok"]
+            else (503, {}, b'{"status": "degraded"}')
+        ),
+        "http://r1": (200, {}, b'{"status": "ok"}'),
+    }, up_after=2, down_after=2)
+    assert app.probe_once() == {"r0": True, "r1": True}
+    state["r0_ok"] = False
+    app.probe_once()
+    assert app.ring_members() == ["r0", "r1"]  # hysteresis holds
+    app.probe_once()
+    assert app.ring_members() == ["r1"]
+    health = app.health()
+    assert health["status"] == "ok" and health["ring_size"] == 1
+    assert health["replicas"]["r0"]["in_ring"] is False
+    # ejected replicas keep being probed so they can rejoin
+    state["r0_ok"] = True
+    app.probe_once()
+    app.probe_once()
+    assert app.ring_members() == ["r0", "r1"]
+    assert app.metrics.ring_transitions.value(replica="r0", to="down") == 1
+    assert app.metrics.ring_transitions.value(replica="r0", to="up") == 1
+
+
+# ----------------------------------------------------- hot-swap state machine
+
+
+def test_engine_swap_weights_flips_generation():
+    engine = FakeEngine(checkpoint_step=1)
+    params, batch_stats = fake_variables(7)
+    ws = engine.swap_weights(params, batch_stats, 7)
+    assert ws.generation == 1 and ws.checkpoint_step == 7
+    assert engine.generation == 1 and engine.checkpoint_step == 7
+    # predict against a PRE-swap snapshot still uses the old weights
+    old = FakeEngine(checkpoint_step=1).weights()
+    entry = engine.predict(np.zeros((8, 8, 3), np.uint8), weights=old)
+    assert float(np.asarray(entry.mpi_rgb).flat[0]) == 1.0
+
+
+def test_engine_swap_rejects_mismatched_tree_and_keeps_serving():
+    from mine_tpu.serving.engine import SwapRejected
+
+    engine = FakeEngine(checkpoint_step=1)
+    with pytest.raises(SwapRejected) as exc_info:
+        engine.swap_weights({"w": np.zeros((5,), np.float32)}, {}, 2)
+    assert "leaf" in str(exc_info.value)  # names the offending leaf
+    with pytest.raises(SwapRejected) as exc_info:
+        engine.swap_weights({"v": np.zeros((4,), np.float32)}, {}, 2)
+    assert "missing leaf params/w" in str(exc_info.value)
+    # rollback: generation 0 still serving, untouched
+    assert engine.generation == 0 and engine.checkpoint_step == 1
+    entry = engine.predict(np.zeros((8, 8, 3), np.uint8))
+    assert float(np.asarray(entry.mpi_rgb).flat[0]) == 1.0
+
+
+def test_app_swap_ok_rotates_cache_keys_and_counts():
+    app = make_fake_app(checkpoint_step=1,
+                        swap_source=lambda: fake_checkpoint(2))
+    try:
+        before = app.predict(_png(0))
+        assert before["mpi_key"].split(":")[1] == "1"
+        status = app.swap(wait=True)
+        assert status["state"] == "ok"
+        assert status["generation"] == 1 and status["checkpoint_step"] == 2
+        assert app.metrics.swaps.value() == 1
+        assert app.metrics.weight_generation.value() == 1
+        assert app.health()["weight_generation"] == 1
+        # the checkpoint-step fence: same image, NEW key, old key servable
+        after = app.predict(_png(0))
+        assert after["mpi_key"].split(":")[1] == "2"
+        assert after["cached"] is False
+        from mine_tpu.serving.cache import key_from_str
+
+        assert app.cache.get(key_from_str(before["mpi_key"])) is not None
+    finally:
+        app.close()
+
+
+def test_app_swap_rejected_is_named_counted_and_rolled_back():
+    app = make_fake_app(
+        checkpoint_step=1,
+        swap_source=lambda: ({"w": np.zeros((9,), np.float32)}, {}, 2),
+    )
+    try:
+        status = app.swap(wait=True)
+        assert status["state"] == "failed" and status["reason"] == "rejected"
+        assert "SwapRejected" in status["error"]
+        assert app.metrics.swap_failures.value(reason="rejected") == 1
+        assert app.engine.generation == 0
+        assert app.engine.checkpoint_step == 1
+        assert app.predict(_png(1))["mpi_key"].split(":")[1] == "1"
+    finally:
+        app.close()
+
+
+def test_app_swap_corrupt_seam_fails_load_never_flips():
+    app = make_fake_app(checkpoint_step=1,
+                        swap_source=lambda: fake_checkpoint(2))
+    try:
+        chaos.install("corrupt_swap@swap=1")
+        status = app.swap(wait=True)
+        assert status["state"] == "failed" and status["reason"] == "load"
+        assert "ChaosFault" in status["error"]
+        assert app.metrics.swap_failures.value(reason="load") == 1
+        assert app.engine.generation == 0
+        # the fault fired once; the next swap succeeds (transient model)
+        status = app.swap(wait=True)
+        assert status["state"] == "ok" and app.engine.generation == 1
+    finally:
+        app.close()
+
+
+def test_app_swap_noop_on_same_step_and_concurrent_refused():
+    gate = threading.Event()
+
+    def slow_source():
+        gate.wait(10)
+        return fake_checkpoint(2)
+
+    app = make_fake_app(checkpoint_step=2, swap_source=slow_source)
+    try:
+        first = app.swap()  # async; worker blocked on the gate
+        assert first["state"] == "in_progress"
+        second = app.swap()  # concurrent trigger: refused, not queued
+        assert second["state"] == "in_progress"
+        assert app.metrics.swap_failures.value(reason="in_progress") == 1
+        gate.set()
+        app._swap_thread.join(timeout=10)
+        # same step -> noop, no generation flip
+        assert app.swap_status()["state"] == "noop"
+        assert app.engine.generation == 0
+    finally:
+        gate.set()
+        app.close()
+
+
+def test_app_swap_unexpected_error_never_wedges_the_state_machine():
+    """A non-SwapError escaping the engine (device OOM placing the
+    candidate, a racing compile failure) must land as a named 'internal'
+    failure — not kill the worker thread with _swap_status stuck at
+    in_progress, which would refuse every future swap until restart."""
+    app = make_fake_app(checkpoint_step=1,
+                        swap_source=lambda: fake_checkpoint(2))
+    try:
+        real = app.engine.swap_weights
+        app.engine.swap_weights = lambda *a, **k: (_ for _ in ()).throw(
+            RuntimeError("device OOM while placing candidate")
+        )
+        status = app.swap(wait=True)
+        assert status["state"] == "failed"
+        assert status["reason"] == "internal"
+        assert "device OOM" in status["error"]
+        assert app.metrics.swap_failures.value(reason="internal") == 1
+        assert app.engine.generation == 0  # old generation serving
+        # NOT wedged: the next swap runs (and succeeds once the engine is
+        # back to normal)
+        app.engine.swap_weights = real
+        assert app.swap(wait=True)["state"] == "ok"
+    finally:
+        app.close()
+
+
+def test_urllib_transport_maps_mid_response_death_to_connect_error():
+    """A replica that accepts the connection but dies before/while writing
+    its response (garbage status line, truncated body) must surface as
+    ConnectionError so forward() fails over — not escape as a router 500."""
+    import socket
+
+    from mine_tpu.serving.fleet import _urllib_transport
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def bad_replica():
+        conn, _ = srv.accept()
+        conn.recv(4096)
+        conn.sendall(b"not an http status line\r\n")
+        conn.close()
+
+    t = threading.Thread(target=bad_replica, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ConnectionError):
+            _urllib_transport("GET", f"http://127.0.0.1:{port}/healthz",
+                              None, {}, 5.0)
+    finally:
+        t.join(timeout=5)
+        srv.close()
+
+
+def test_app_swap_without_source_is_a_config_error():
+    app = make_fake_app()
+    try:
+        with pytest.raises(ValueError):
+            app.swap()
+    finally:
+        app.close()
+
+
+def test_maybe_promote_follows_last_good_pointer(tmp_path):
+    from mine_tpu.training.checkpoint import mark_last_good
+
+    ws = str(tmp_path / "ws")
+    app = make_fake_app(checkpoint_step=5, swap_source=ws)
+    try:
+        assert app.maybe_promote() is None  # no pointer yet
+        mark_last_good(ws, 3)
+        assert app.maybe_promote() is None  # pointer older than serving
+        # pointer newer but NO retained checkpoint at/under it: a quiet
+        # no-op (never an endless restore-and-noop loop, never a promote
+        # of something fresher than the pointer)
+        mark_last_good(ws, 9)
+        assert app.maybe_promote() is None
+        assert app.engine.checkpoint_step == 5  # still serving
+    finally:
+        app.close()
+
+
+def test_maybe_promote_takes_vetted_step_not_newest(tmp_path):
+    """The promotion watch exists to serve the sentinel contract: a
+    freshly written, NOT-yet-vetted checkpoint (newer than last_good) must
+    never be promoted — the swap targets the newest retained step at or
+    under the pointer."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from mine_tpu.config import Config, save_config
+    from mine_tpu.training.checkpoint import (
+        checkpoint_manager,
+        mark_last_good,
+    )
+
+    ws = str(tmp_path / "ws")
+    import os
+
+    os.makedirs(ws)
+    save_config(Config(), os.path.join(ws, "params.yaml"))
+    app = make_fake_app(checkpoint_step=5, swap_source=ws)
+    try:
+        manager = checkpoint_manager(ws, max_to_keep=10)
+        for step in (5, 9, 12):
+            params, batch_stats = fake_variables(step)
+            manager.save(step, args=ocp.args.StandardSave(
+                {"params": params, "batch_stats": batch_stats}
+            ))
+        manager.wait_until_finished()
+        mark_last_good(ws, 9)  # 12 exists but is NOT vetted
+        status = app.maybe_promote()
+        assert status is not None and status["state"] == "ok", status
+        assert app.engine.checkpoint_step == 9  # the vetted one, not 12
+        fill = float(
+            jax.tree_util.tree_leaves(app.engine.variables["params"])[0][0]
+        )
+        assert fill == 9.0  # the step-9 payload, proven by value
+    finally:
+        app.close()
+
+
+def test_load_for_serving_validates_against_expected_tree():
+    import jax
+
+    from mine_tpu.training.checkpoint import (
+        CheckpointTreeMismatch,
+        validate_variables_tree,
+    )
+
+    good = {"params": {"w": np.zeros((4,), np.float32)}, "batch_stats": {}}
+    validate_variables_tree(good, good)  # arrays vs arrays
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), good
+    )
+    validate_variables_tree(abstract, good)  # abstract expected
+    with pytest.raises(CheckpointTreeMismatch) as exc_info:
+        validate_variables_tree(
+            abstract,
+            {"params": {"w": np.zeros((4, 2), np.float32)},
+             "batch_stats": {}},
+        )
+    assert "params/w" in str(exc_info.value)
+    with pytest.raises(CheckpointTreeMismatch, match="missing leaf"):
+        validate_variables_tree(abstract, {"params": {}, "batch_stats": {}})
+    with pytest.raises(CheckpointTreeMismatch, match="unexpected leaf"):
+        validate_variables_tree(
+            abstract,
+            {"params": {"w": np.zeros((4,), np.float32),
+                        "extra": np.zeros(1, np.float32)},
+             "batch_stats": {}},
+        )
+
+
+# ------------------------------------------------------- bench_fleet smoke
+
+
+def test_bench_fleet_run_quotes_p95_and_concentration():
+    """The load harness's core on a tiny trace: router percentiles present,
+    and the digest-affinity claim holds — fleet-wide encoder invocations
+    == distinct images (every image encoded on exactly one replica)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_fleet",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "bench_fleet.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    result = bench.run(replicas=2, images=4, requests=24, concurrency=3)
+    assert result["metric"] == "fleet_renders_per_sec"
+    assert result["value"] > 0
+    assert result["router_p95_ms"] >= result["router_p50_ms"] > 0
+    assert result["encoder_invocations_total"] == 4  # affinity, fleet-wide
+    assert len(result["per_replica"]) == 2
+    assert result["cache_hit_rate"] > 0.5
+
+
+# -------------------------------------------- the drill's fleet half (smoke)
+
+
+def test_chaos_drill_fleet_half():
+    """The acceptance scenario end to end over real HTTP: replica-kill
+    mid-flood -> only 200/503 + ring convergence; mid-flood hot swap ->
+    zero swap-attributable 5xx + key rotation; corrupt swap -> named
+    rejection with the old generation serving. Fake engines: no compiles."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drill",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "chaos_drill.py"),
+    )
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+    result = drill.fleet_half(timeout_s=120.0)
+    assert result["ok"], json.dumps(result, indent=2)
+    assert result["kill_flood_only_200_503"]
+    assert result["ring_converged_to"] == 2
+    assert result["swap_zero_5xx"]
+    assert result["post_swap_key_rotated"]
+    assert result["corrupt_swap_rolled_back"]

@@ -34,6 +34,64 @@ def _plane_mesh(n):
     return Mesh(np.asarray(jax.devices()[:n]), ("plane",))
 
 
+def test_init_multihost_timeout_raises_named_error(monkeypatch):
+    """A rendezvous that never completes (one pod host missing) must end
+    in the actionable MultihostInitTimeout, not an indefinite hang."""
+    import threading
+
+    from mine_tpu.parallel.mesh import MultihostInitTimeout, init_multihost
+
+    monkeypatch.setenv("MINE_TPU_MULTIHOST", "1")
+    release = threading.Event()
+
+    def hanging_client(**kwargs):
+        release.wait(30)  # a fake distributed client stuck on peers
+
+    with pytest.raises(MultihostInitTimeout) as exc_info:
+        init_multihost(timeout_s=0.2, initialize_fn=hanging_client)
+    release.set()
+    msg = str(exc_info.value)
+    assert "did not complete within" in msg
+    assert "MINE_TPU_MULTIHOST_TIMEOUT_S" in msg  # actionable knob named
+
+
+def test_init_multihost_fake_client_outcomes(monkeypatch):
+    from mine_tpu.parallel.mesh import init_multihost
+
+    calls: list[dict] = []
+
+    def ok_client(**kwargs):
+        calls.append(kwargs)
+
+    # opt-in gate: without the env or a coordinator, never initializes
+    monkeypatch.delenv("MINE_TPU_MULTIHOST", raising=False)
+    init_multihost(initialize_fn=ok_client)
+    assert calls == []
+    # explicit coordinator: passed through
+    init_multihost(coordinator="host:1234", timeout_s=5,
+                   initialize_fn=ok_client)
+    assert calls == [{"coordinator_address": "host:1234"}]
+
+    # "already initialized" is success; other RuntimeErrors with an
+    # explicit coordinator propagate (a real bring-up failure)
+    def already(**kwargs):
+        raise RuntimeError("jax.distributed already initialized")
+
+    init_multihost(coordinator="host:1234", timeout_s=5,
+                   initialize_fn=already)
+
+    def broken(**kwargs):
+        raise RuntimeError("connection refused by coordinator")
+
+    with pytest.raises(RuntimeError, match="connection refused"):
+        init_multihost(coordinator="host:1234", timeout_s=5,
+                       initialize_fn=broken)
+    # ...but auto-detection failures on an env-gated run degrade to
+    # single-host (no cluster environment is not an error)
+    monkeypatch.setenv("MINE_TPU_MULTIHOST", "1")
+    init_multihost(timeout_s=5, initialize_fn=broken)
+
+
 def test_make_mesh_shapes():
     mesh = make_mesh()
     assert mesh.devices.size == 8

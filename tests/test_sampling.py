@@ -24,6 +24,22 @@ def test_stratified_linspace_within_bins():
     assert np.all(np.diff(d, axis=1) < 0)
 
 
+def test_stratified_rows_are_batch_size_invariant():
+    """Example i's draw must depend only on (key, i), not on how many other
+    examples share the batch — the property the eval wrap-pad masking
+    relies on (a weight-0 duplicate slot must not perturb genuine slots)."""
+    key = jax.random.PRNGKey(7)
+    d1 = np.asarray(uniform_disparity_from_linspace_bins(key, 1, 8, 1.0, 0.01))
+    d4 = np.asarray(uniform_disparity_from_linspace_bins(key, 4, 8, 1.0, 0.01))
+    np.testing.assert_array_equal(d1[0], d4[0])
+    edges = np.linspace(1.0, 0.05, 5).astype(np.float32)
+    e2 = np.asarray(uniform_disparity_from_bins(key, 2, edges))
+    e3 = np.asarray(uniform_disparity_from_bins(key, 3, edges))
+    np.testing.assert_array_equal(e2, e3[:2])
+    # rows are still distinct draws (not one row broadcast)
+    assert not np.allclose(d4[0], d4[1])
+
+
 def test_stratified_explicit_bins():
     key = jax.random.PRNGKey(1)
     edges = np.array([1.0, 0.5, 0.2, 0.05], dtype=np.float32)

@@ -586,3 +586,54 @@ def test_serving_end_to_end_http(served_workspace):
     finally:
         server.shutdown()
         app.close()
+
+
+def test_real_engine_hot_swap_via_last_good_promotion(served_workspace):
+    """The production hot-swap path with a REAL compiled engine: a newer
+    checkpoint + last_good pointer -> maybe_promote() loads it through
+    load_for_serving's expected-tree validation, the swap verify re-proves
+    the already-compiled bucket (NO recompile), the generation flips
+    atomically, and the MPI cache's checkpoint-step key rotates while the
+    old generation's entry stays servable."""
+    import jax
+
+    from mine_tpu.serving.server import ServingApp
+    from mine_tpu.training import checkpoint as ckpt
+    from mine_tpu.training.checkpoint import load_for_serving
+
+    workspace, _, state = served_workspace
+    cfg, params, batch_stats, step = load_for_serving(workspace)
+    assert step == 5
+    app = ServingApp(
+        cfg, params, batch_stats, checkpoint_step=step,
+        cache_bytes=64 << 20, max_delay_ms=0.0, swap_source=workspace,
+    )
+    try:
+        png = _scene_png(phase=1.3)
+        before = app.predict(png)
+        assert key_from_str(before["mpi_key"])[1] == 5
+        compiles_before = app.engine.compiles
+
+        # nothing newer vetted yet: no swap triggered
+        assert app.maybe_promote() is None
+
+        manager = ckpt.checkpoint_manager(workspace)
+        ckpt.save(manager, jax.device_get(state), 6)
+        ckpt.wait_until_finished(manager)
+        ckpt.mark_last_good(workspace, 6)
+        status = app.maybe_promote()
+        assert status is not None and status["state"] == "ok", status
+        assert app.engine.checkpoint_step == 6
+        assert app.engine.generation == 1
+        assert app.metrics.swaps.value() == 1
+        # the verify dispatch ran on the existing executable — shape
+        # validation guarantees the warm bucket set carries over
+        assert app.engine.compiles == compiles_before
+
+        after = app.predict(png)
+        assert key_from_str(after["mpi_key"])[1] == 6
+        assert after["cached"] is False  # the step fence: a NEW cache entry
+        # the old generation's entry is still resident and servable
+        assert app.cache.get(key_from_str(before["mpi_key"])) is not None
+    finally:
+        app.close()

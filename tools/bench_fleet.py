@@ -1,0 +1,323 @@
+#!/usr/bin/env python3
+"""Fleet load harness: router p95 + cache-hit concentration over K replicas.
+
+Spawns K in-process replicas + the consistent-hash fleet router
+(mine_tpu/serving/fleet.py) and replays a synthetic trace of M distinct
+images with a skewed popularity distribution through the REAL HTTP
+surfaces — router parse/route/forward, replica decode/cache/batcher/render,
+PNG encode. Reports:
+
+  * fleet_renders_per_sec + client-measured router p50/p95 (the end-to-end
+    number a capacity plan needs; p95 rides the perf-ledger row and is
+    gated by `tools/perf_ledger.py check` on the dedicated fleet stream),
+  * per-replica cache-hit concentration — the digest-affinity claim made
+    measurable: with consistent-hash routing every image's encoder pass
+    runs on exactly ONE replica, so fleet-wide encoder_invocations == M
+    (without affinity it would approach K*M) and the per-replica hit
+    tables show the arcs.
+
+Replicas default to FAKE engines (serving/fake.py — the control plane is
+what this bench measures; an XLA render would swamp the routing numbers
+with model FLOPs and cost K compiles). --real switches to real random-init
+engines for an end-to-end-with-XLA measurement.
+
+Prints exactly one JSON line (bench.py contract); the run() core is
+importable for the tier-1 smoke.
+
+  python tools/bench_fleet.py                          # 3 fake replicas
+  python tools/bench_fleet.py --replicas 5 --requests 400
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+METRIC = "fleet_renders_per_sec"
+
+
+def _make_pngs(n: int, size: int = 8) -> list[bytes]:
+    """n tiny distinct PNGs (distinct pixels -> distinct digests)."""
+    import numpy as np
+    from PIL import Image
+
+    pngs = []
+    for i in range(n):
+        img = np.full((size, size, 3), (i * 37) % 256, np.uint8)
+        img[0, 0] = (i % 256, (i // 256) % 256, 7)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        pngs.append(buf.getvalue())
+    return pngs
+
+
+def _http(base: str, path: str, data=None, headers=None, timeout=120):
+    req = urllib.request.Request(base + path, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def _metric_value(text: str, name: str, default=0.0) -> float:
+    total, seen = 0.0, False
+    for line in text.splitlines():
+        if line.startswith(name) and (line[len(name)] in " {"):
+            total += float(line.rsplit(" ", 1)[1])
+            seen = True
+    return total if seen else default
+
+
+def _real_replica_app():
+    import jax
+
+    from mine_tpu.config import Config
+    from mine_tpu.serving.server import ServingApp
+    from mine_tpu.training.step import build_model
+
+    cfg = Config().replace(**{
+        "data.name": "synthetic", "data.img_h": 128, "data.img_w": 128,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "mpi.num_bins_coarse": 2,
+    })
+    model = build_model(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jax.numpy.zeros((1, 128, 128, 3)),
+        jax.numpy.linspace(1.0, 0.01, 2)[None], True,
+    )
+    return ServingApp(cfg, variables["params"],
+                      variables.get("batch_stats", {}), max_delay_ms=0.0)
+
+
+def run(
+    replicas: int = 3,
+    images: int = 12,
+    requests: int = 150,
+    concurrency: int = 6,
+    real: bool = False,
+    vnodes: int = 64,
+) -> dict:
+    """The measurement core; returns the result dict (no printing)."""
+    import numpy as np
+
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.fleet import FleetApp, make_fleet_server
+    from mine_tpu.serving.server import make_server
+
+    apps, servers, urls = [], [], {}
+    try:
+        for i in range(replicas):
+            app = _real_replica_app() if real else make_fake_app()
+            srv = make_server(app)
+            host, port = srv.server_address[:2]
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            apps.append(app)
+            servers.append(srv)
+            urls[f"r{i}"] = f"http://{host}:{port}"
+        fleet = FleetApp(urls, probe_interval_s=1.0, vnodes=vnodes).start()
+        fleet_srv = make_fleet_server(fleet)
+        fh, fp = fleet_srv.server_address[:2]
+        threading.Thread(target=fleet_srv.serve_forever, daemon=True).start()
+        base = f"http://{fh}:{fp}"
+
+        pngs = _make_pngs(images)
+        # seed: every image predicted once through the router (the fleet's
+        # steady state: the working set resident, one arc per digest)
+        keys: list[str] = []
+        for png in pngs:
+            code, body = _http(base, "/predict", data=png,
+                               headers={"Content-Type": "image/png"})
+            assert code == 200, body
+            keys.append(json.loads(body)["mpi_key"])
+
+        # skewed popularity (~1/rank): the realistic trace shape — a few
+        # hot images dominate, the tail keeps every replica's arc warm
+        rng = np.random.default_rng(0)
+        weights = 1.0 / np.arange(1, images + 1)
+        weights /= weights.sum()
+        picks = rng.choice(images, size=requests, p=weights)
+        # every request = a cache-hitting /predict (affinity check) + a
+        # one-pose /render; payloads precomputed outside the timed window
+        work = [
+            (pngs[i], json.dumps({
+                "mpi_key": keys[i], "offsets": [[0.01, 0.0, 0.0]],
+            }).encode())
+            for i in picks
+        ]
+        work_lock = threading.Lock()
+        latencies: list[float] = []
+        errors: list[str] = []
+
+        def client():
+            while True:
+                with work_lock:
+                    if not work:
+                        return
+                    png, render_payload = work.pop()
+                t0 = time.perf_counter()
+                c1, b1 = _http(base, "/predict", data=png,
+                               headers={"Content-Type": "image/png"})
+                c2, _ = _http(base, "/render", data=render_payload,
+                              headers={"Content-Type": "application/json"})
+                dt = time.perf_counter() - t0
+                with work_lock:
+                    if c1 == 200 and c2 == 200:
+                        latencies.append(dt)
+                    else:
+                        errors.append(f"predict={c1} render={c2}")
+                    if c1 == 200 and not json.loads(b1)["cached"]:
+                        errors.append("seeded predict missed the cache")
+
+        clients = [threading.Thread(target=client)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=600)
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)}/{requests} fleet requests failed: {errors[0]}"
+            )
+
+        # per-replica concentration from each replica's own counters
+        per_replica = []
+        total_encoders = total_hits = total_misses = 0.0
+        for name, url in urls.items():
+            _, body = _http(url, "/metrics")
+            text = body.decode()
+            enc = _metric_value(text, "mine_serve_encoder_invocations_total")
+            hits = _metric_value(text, "mine_serve_cache_hits_total")
+            misses = _metric_value(text, "mine_serve_cache_misses_total")
+            total_encoders += enc
+            total_hits += hits
+            total_misses += misses
+            per_replica.append({
+                "replica": name, "encoder_invocations": enc,
+                "cache_hits": hits, "cache_misses": misses,
+            })
+        _, body = _http(base, "/metrics")
+        fleet_text = body.decode()
+
+        result = {
+            "metric": METRIC,
+            "value": round(requests / elapsed, 2),
+            "unit": "renders/sec",
+            "replicas": replicas, "images": images,
+            "requests": requests, "concurrency": concurrency,
+            "engine": "real" if real else "fake",
+            "elapsed_s": round(elapsed, 2),
+            "router_p50_ms": round(
+                1e3 * float(np.percentile(latencies, 50)), 1),
+            "router_p95_ms": round(
+                1e3 * float(np.percentile(latencies, 95)), 1),
+            # the digest-affinity proof: one encoder pass per image
+            # fleet-wide (no affinity => up to replicas*images)
+            "encoder_invocations_total": total_encoders,
+            "cache_hit_rate": round(
+                total_hits / max(total_hits + total_misses, 1.0), 4),
+            "per_replica": per_replica,
+            "failovers": _metric_value(
+                fleet_text, "mine_fleet_failovers_total"),
+            "note": (
+                "end-to-end through router+replica HTTP; every request = "
+                "cache-hitting predict + 1-pose render; fake engines "
+                "isolate routing/control-plane cost" if not real else
+                "end-to-end through router+replica HTTP with real XLA "
+                "render dispatches"
+            ),
+        }
+        return result
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()  # shutdown() alone leaks the listening fd
+        try:
+            fleet_srv.shutdown()
+            fleet_srv.server_close()
+            fleet.close()
+        except NameError:
+            pass
+        for app in apps:
+            app.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--images", type=int, default=12)
+    ap.add_argument("--requests", type=int, default=150)
+    ap.add_argument("--concurrency", type=int, default=6)
+    ap.add_argument("--real", action="store_true",
+                    help="real random-init engines instead of fake ones "
+                    "(costs one XLA compile per replica)")
+    args = ap.parse_args()
+
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    result = run(
+        replicas=args.replicas, images=args.images,
+        requests=args.requests, concurrency=args.concurrency,
+        real=args.real,
+    )
+
+    # perf ledger (obs/ledger.py): the DEDICATED fleet stream — metric name
+    # + workload digest keep it disjoint from single-replica serve rows;
+    # p95_ms is an AUX_METRICS field, so `perf_ledger.py check` gates it
+    try:
+        import jax
+
+        from mine_tpu.obs import ledger
+
+        row = ledger.append_bench_row({
+            "metric": METRIC, "value": result["value"],
+            "unit": "renders/sec", "higher_is_better": True,
+            "p50_ms": result["router_p50_ms"],
+            "p95_ms": result["router_p95_ms"],
+            "device": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
+        }, workload={
+            "replicas": args.replicas, "images": args.images,
+            "requests": args.requests, "concurrency": args.concurrency,
+            "engine": result["engine"],
+        })
+        if row is not None:
+            result["ledger_row"] = row
+    except Exception as exc:  # noqa: BLE001 - the number outranks the ledger
+        print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 - emit-then-reraise contract
+        if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+            raise
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": METRIC, "value": None, "unit": "renders/sec",
+            "error": f"{type(exc).__name__}: {exc}"[:2000],
+        }))
+        sys.stdout.flush()
+        os._exit(1)

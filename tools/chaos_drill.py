@@ -26,8 +26,21 @@ Serving half (in-process live HTTP server over the trained checkpoint):
   queue bound + deadline must produce only 200/503/504 (shed + expired),
   never a hang and never a 500, with the shed/timeout counters ticking.
 
+Fleet half (in-process fake-weight fleet: 3 replicas + the consistent-hash
+router, mine_tpu/serving/fleet.py — control-plane truth needs no XLA):
+  replica-kill mid-flood (`replica_kill@request=N` seam): the router
+  fails over on the dropped connections, every logical request resolves
+  200/503 (a 404 is the documented re-predict contract, retried by the
+  client), and the health-gated ring converges to the survivors.
+  Mid-flood hot swap (/admin/swap fan-out): every replica flips to a new
+  weight generation with ZERO swap-attributable 5xx; post-swap predicts
+  mint new-generation cache keys while old mpi_keys stay servable.
+  Corrupt-checkpoint swap (`corrupt_swap@swap=1` seam): the swap is
+  REJECTED with a named error + counter, the old generation still serves
+  (follow-up requests 200), and nothing 5xxs.
+
 Usage:
-  python tools/chaos_drill.py [--half training|serving|all]
+  python tools/chaos_drill.py [--half training|serving|fleet|all]
                               [--workdir DIR] [--no-exact] [--steps N]
 """
 
@@ -339,9 +352,236 @@ def serving_half(workdir: str, timeout_s: float) -> dict:
     return result
 
 
+def fleet_half(timeout_s: float) -> dict:
+    """Replica-kill, hot-swap, and corrupt-swap against a live fake-weight
+    fleet. Importable (tests/test_fleet.py runs it as the tier-1 drill
+    smoke — zero XLA compiles)."""
+    import io
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+    from PIL import Image
+
+    from mine_tpu.resilience import chaos
+    from mine_tpu.serving.fake import fake_checkpoint, make_fake_app
+    from mine_tpu.serving.fleet import FleetApp, make_fleet_server
+    from mine_tpu.serving.server import make_server
+
+    result: dict = {}
+    apps, servers, urls = [], [], {}
+    fleet = fleet_srv = None
+    try:
+        for i in range(3):
+            app = make_fake_app(
+                checkpoint_step=1,
+                swap_source=lambda: fake_checkpoint(2),
+            )
+            srv = make_server(app)
+            host, port = srv.server_address[:2]
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            apps.append(app)
+            servers.append(srv)
+            urls[f"r{i}"] = f"http://{host}:{port}"
+        fleet = FleetApp(urls, probe_interval_s=0.25, probe_timeout_s=2.0,
+                         up_after=2, down_after=2, max_attempts=3,
+                         deadline_s=15.0).start()
+        fleet_srv = make_fleet_server(fleet)
+        fh, fp = fleet_srv.server_address[:2]
+        threading.Thread(target=fleet_srv.serve_forever,
+                         daemon=True).start()
+        base = f"http://{fh}:{fp}"
+
+        def http(path, data=None, headers=None, timeout=30.0):
+            req = urllib.request.Request(base + path, data=data,
+                                         headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as err:
+                return err.code, err.read()
+
+        pngs = []
+        for i in range(6):
+            img = np.full((8, 8, 3), (i * 41) % 256, np.uint8)
+            img[0, 0] = (i, 0, 0)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="PNG")
+            pngs.append(buf.getvalue())
+        keys = []
+        for png in pngs:
+            code, body = http("/predict", data=png,
+                              headers={"Content-Type": "image/png"})
+            assert code == 200, body
+            keys.append(json.loads(body)["mpi_key"])
+
+        def one_request(i: int) -> int:
+            """One logical client request honoring the documented 404
+            contract (MPI not cached on the answering replica after a
+            membership change -> re-predict, then render again)."""
+            png, key = pngs[i % 6], keys[i % 6]
+            payload = json.dumps({
+                "mpi_key": key, "offsets": [[0.01, 0.0, 0.0]],
+            }).encode()
+            hdr = {"Content-Type": "application/json"}
+            code, _ = http("/render", data=payload, headers=hdr)
+            if code == 404:
+                pc, _ = http("/predict", data=png,
+                             headers={"Content-Type": "image/png"})
+                if pc != 200:
+                    return pc
+                code, _ = http("/render", data=payload, headers=hdr)
+            return code
+
+        def flood(n_threads: int, per_thread: int,
+                  mid_flood=None) -> list[int]:
+            codes: list[int] = []
+            lock = threading.Lock()
+
+            def client():
+                for i in range(per_thread):
+                    c = one_request(i)
+                    with lock:
+                        codes.append(c)
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            if mid_flood is not None:
+                time.sleep(0.15)  # let the flood establish
+                mid_flood()
+            for t in threads:
+                t.join(timeout=timeout_s)
+            return codes
+
+        # ---- phase A: replica-kill mid-flood --------------------------------
+        schedule = chaos.install("replica_kill@request=60")
+        codes_a = flood(4, 50)
+        result["kill_fired"] = schedule.pending() == []
+        chaos.uninstall()
+        result["kill_flood_requests"] = len(codes_a)
+        result["kill_flood_codes"] = sorted(set(codes_a))
+        result["kill_flood_only_200_503"] = all(
+            c in (200, 503) for c in codes_a
+        )
+        result["kill_flood_error_burst"] = sum(
+            1 for c in codes_a if c != 200
+        )
+        deadline = time.monotonic() + 20.0
+        while (len(fleet.ring_members()) != 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        result["ring_converged_to"] = len(fleet.ring_members())
+        codes_after = [one_request(i) for i in range(12)]
+        result["post_kill_all_200"] = all(c == 200 for c in codes_after)
+
+        # ---- phase B: hot swap mid-flood ------------------------------------
+        swap_results: dict = {}
+
+        def trigger_swap():
+            code, body = http(
+                "/admin/swap", data=json.dumps({"wait": True}).encode(),
+                headers={"Content-Type": "application/json"}, timeout=60.0,
+            )
+            swap_results["status"] = code
+            swap_results.update(json.loads(body))
+
+        codes_b = flood(4, 50, mid_flood=trigger_swap)
+        result["swap_http_status"] = swap_results.get("status")
+        replicas_swapped = swap_results.get("replicas", {})
+        in_ring = [r for r in replicas_swapped.values() if r.get("in_ring")]
+        dead = [r for r in replicas_swapped.values() if not r.get("in_ring")]
+        # the fan-out reaches ALL 3 configured replicas: both survivors
+        # flip; the killed one is reported as unreachable (never silently
+        # skipped — a revived replica must not rejoin on stale weights)
+        result["swap_replicas_ok"] = (
+            len(replicas_swapped) == 3 and len(in_ring) == 2
+            and all(r.get("state") == "ok" for r in in_ring)
+            and all("error" in r for r in dead)
+        )
+        result["swap_flood_requests"] = len(codes_b)
+        result["swap_flood_codes"] = sorted(set(codes_b))
+        result["swap_zero_5xx"] = all(c < 500 for c in codes_b)
+        # old-generation mpi_keys stay servable; new predicts mint
+        # new-generation keys (the cache's checkpoint-step fence)
+        old_ok, _ = http("/render", data=json.dumps({
+            "mpi_key": keys[0], "offsets": [[0.01, 0.0, 0.0]],
+        }).encode(), headers={"Content-Type": "application/json"})
+        result["old_generation_key_still_served"] = old_ok == 200
+        code, body = http("/predict", data=pngs[0],
+                          headers={"Content-Type": "image/png"})
+        new_key = json.loads(body)["mpi_key"] if code == 200 else ""
+        result["post_swap_key_rotated"] = (
+            code == 200 and new_key.split(":")[1] == "2"
+            and new_key != keys[0]
+        )
+        live_apps = [a for a in apps
+                     if a.engine.checkpoint_step == 2]
+        result["swapped_generations"] = sorted(
+            a.engine.generation for a in live_apps
+        )
+
+        # ---- phase C: corrupt-checkpoint swap rejected ----------------------
+        victim = live_apps[0]
+        gen_before = victim.engine.generation
+        step_before = victim.engine.checkpoint_step
+        chaos.install("corrupt_swap@swap=1")
+        status = victim.swap(wait=True)
+        chaos.uninstall()
+        result["corrupt_swap_state"] = status.get("state")
+        result["corrupt_swap_error_named"] = (
+            "ChaosFault" in str(status.get("error", ""))
+        )
+        result["corrupt_swap_counter"] = victim.metrics.swap_failures.value(
+            reason="load"
+        )
+        result["corrupt_swap_rolled_back"] = (
+            victim.engine.generation == gen_before
+            and victim.engine.checkpoint_step == step_before
+        )
+        codes_c = [one_request(i) for i in range(8)]
+        result["post_corrupt_all_200"] = all(c == 200 for c in codes_c)
+
+        result["ok"] = (
+            result["kill_fired"]
+            and result["kill_flood_only_200_503"]
+            and result["ring_converged_to"] == 2
+            and result["post_kill_all_200"]
+            and result["swap_http_status"] == 200
+            and result["swap_replicas_ok"]
+            and result["swap_zero_5xx"]
+            and result["old_generation_key_still_served"]
+            and result["post_swap_key_rotated"]
+            and result["corrupt_swap_state"] == "failed"
+            and result["corrupt_swap_error_named"]
+            and result["corrupt_swap_counter"] >= 1
+            and result["corrupt_swap_rolled_back"]
+            and result["post_corrupt_all_200"]
+        )
+    finally:
+        chaos.uninstall()
+        for srv in servers:
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except OSError:
+                pass
+        if fleet_srv is not None:
+            fleet_srv.shutdown()
+            fleet_srv.server_close()  # shutdown() alone leaks the fd
+        if fleet is not None:
+            fleet.close()
+        for app in apps:
+            app.close()
+    return result
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--half", choices=("training", "serving", "all"),
+    parser.add_argument("--half",
+                        choices=("training", "serving", "fleet", "all"),
                         default="all")
     parser.add_argument("--workdir", default=None,
                         help="scratch dir (default: a fresh tempdir)")
@@ -368,6 +608,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.half in ("serving", "all"):
             verdict["serving"] = serving_half(workdir, args.timeout_s)
             ok = ok and verdict["serving"]["ok"]
+        if args.half in ("fleet", "all"):
+            verdict["fleet"] = fleet_half(args.timeout_s)
+            ok = ok and verdict["fleet"]["ok"]
         # final step: the perf regression gate (obs/ledger.py, same verdict
         # `python tools/perf_ledger.py check` prints standalone) — a drill
         # that survives its faults but ships a perf regression still fails
