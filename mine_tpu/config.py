@@ -211,6 +211,34 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Serving-side representation knobs (mine_tpu/serving/; no reference
+    analog). Defaults are a numerics NO-OP: fp32 tier + pruning off caches
+    exactly the arrays the predict executable produced (PARITY.md 5.11)."""
+
+    # MPI cache tier: "fp32" (dense, the pre-compression behavior), "bf16"
+    # (half the bytes, dequant-on-render), or "int8" (per-plane-scaled
+    # affine quantization of the RGB+sigma slabs, 1/4 the slab bytes). The
+    # tier is part of every cache key and of the fleet wire format — two
+    # tiers of one image are DIFFERENT cache entries (serving/compress.py).
+    cache_tier: str = "fp32"
+    # transmittance-based plane pruning at predict time: planes whose
+    # maximum compositing weight (accumulated transmittance x alpha, the
+    # same per-plane quantity the streaming compositor scans) never reaches
+    # this threshold anywhere in the image are dropped from the cached MPI
+    # — cutting cache bytes AND render FLOPs (the render runs a
+    # pruned-plane-count executable bucket). 0.0 disables;
+    # serving/compress.py DEFAULT_PRUNE_EPS (1e-3) is the recommended
+    # operating point (PSNR within 0.1 dB on the eval scene, PARITY.md).
+    prune_transmittance_eps: float = 0.0
+    # fleet peer fetch: on a local cache miss a replica asks the ring's
+    # owner replica (GET /mpi/<key>) for the compressed MPI before
+    # re-running the encoder. This bounds the whole attempt; expiry
+    # degrades to a local re-predict, never an error (serving/server.py).
+    peer_fetch_timeout_s: float = 2.0
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout: the named (data, fsdp, plane) axes
     (parallel/mesh.py; no reference analog — the reference's only axis is
@@ -266,6 +294,7 @@ class Config:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
 
     def replace(self, **dot_key_values: Any) -> "Config":
         """Functional update by dot-keys: cfg.replace(**{"mpi.num_bins_coarse": 8})."""

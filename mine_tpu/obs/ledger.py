@@ -43,6 +43,11 @@ LEDGER_ENV = "MINE_TPU_PERF_LEDGER"
 AUX_METRICS: dict[str, bool] = {
     "p95_ms": False,
     "peak_hbm_bytes": False,
+    # compressed-MPI fleet economics (tools/bench_fleet.py): cache entries
+    # the byte budget holds per GiB (∝ 1/bytes-per-entry — a tier or
+    # pruning regression shrinks it) and the skew-trace hit rate it buys
+    "cache_entries_per_gib": True,
+    "cache_hit_rate": True,
 }
 
 

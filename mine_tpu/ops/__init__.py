@@ -27,6 +27,7 @@ from mine_tpu.ops.mpi_render import (
     STREAMING_COMPOSITOR,
     alpha_composition,
     compositor_from_config,
+    plane_contributions,
     plane_tgt_xyz,
     plane_volume_rendering,
     ray_norms,

@@ -228,6 +228,62 @@ def render_src(
     return rgb_out, depth_out, transparency_acc, weights
 
 
+@_scoped("composite")
+def plane_contributions(
+    sigma: Array, mpi_disparity: Array, k_inv: Array,
+    use_alpha: bool = False,
+    vis_dilate_px: int = 8,
+) -> Array:
+    """Per-plane maximum compositing weight: alpha times a PARALLAX-AWARE
+    accumulated transmittance — the per-plane quantity the compositors
+    (dense cumprod chain and streaming scan alike) weight every plane's
+    rgb by — reduced with max over batch and pixels.
+
+    sigma: (B, S, H, W, 1); mpi_disparity: (B, S); k_inv: (B, 3, 3).
+    Returns (S,): a plane whose value is ~0 contributes to NO ray, source
+    or novel, so dropping it is visually free (the pruning contract quoted
+    in serving/compress.py; tolerance pinned by the convergence-harness
+    parity gate in tests/test_compress.py).
+
+    The source-pose transmittance alone would over-prune: a plane fully
+    occluded at the source pose (T = 0 under a foreground surface) is
+    exactly what a novel pose REVEALS in disocclusion regions — the
+    content the whole predict-once/render-many product exists to show.
+    So visibility is dilated spatially first: each pixel takes the max
+    accumulated transmittance within `vis_dilate_px` (a bound on how far
+    parallax can slide occluders between the source and any rendered
+    pose) before multiplying by alpha. A plane opaque under a foreground
+    edge survives; a plane buried EVERYWHERE deeper than the parallax
+    radius still prunes.
+
+    The max (not mean) over pixels is deliberate: one small opaque
+    foreground object on an otherwise empty plane must keep that plane
+    alive.
+    """
+    h, w = sigma.shape[2], sigma.shape[3]
+    if use_alpha:
+        alpha = sigma
+        transparency = 1.0 - alpha
+    else:
+        dist = _src_dists(mpi_disparity, k_inv, h, w)
+        transparency = jnp.exp(-sigma * dist)
+        alpha = 1.0 - transparency
+    # same eps'd cumprod as plane_volume_rendering/render_src so the
+    # thresholded quantity is the one the renderer actually uses
+    transparency_acc = _shifted_exclusive(
+        jnp.cumprod(transparency + 1.0e-6, axis=1)
+    )
+    if vis_dilate_px > 0:
+        d = 2 * int(vis_dilate_px) + 1
+        transparency_acc = lax.reduce_window(
+            transparency_acc, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, d, d, 1),
+            window_strides=(1, 1, 1, 1, 1), padding="SAME",
+        )
+    weights = transparency_acc * alpha  # (B, S, H, W, 1)
+    return jnp.max(weights, axis=(0, 2, 3, 4))
+
+
 def _affine_tgt_xyz(
     src_xy: Array, depth: Array, g_flat: Array, k_inv_flat: Array,
     h: int, w: int,

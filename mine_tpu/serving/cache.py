@@ -8,12 +8,18 @@ three orders of magnitude bigger than a typical KV-cache entry. An
 entry-counted LRU would let a handful of high-resolution predicts silently
 exhaust HBM, so the budget — and the eviction accounting — is in BYTES.
 
-Keys are (image_digest, checkpoint_step, H, W, S): the same image predicted
-under a newer checkpoint, at a different resolution, or at a different
-plane count is a DIFFERENT MPI — omitting any of these would alias entries
-and silently serve frames at the wrong operating point. The digest is of
-the uploaded image bytes, computed by the caller (server.py) before any
-decode.
+Keys are (image_digest, checkpoint_step, H, W, S, tier): the same image
+predicted under a newer checkpoint, at a different resolution, at a
+different plane count, or cached at a different compression tier
+(serving/compress.py — an int8 entry is NOT the fp32 entry) is a DIFFERENT
+MPI — omitting any of these would alias entries and silently serve frames
+at the wrong operating point. The digest is of the uploaded image bytes,
+computed by the caller (server.py) before any decode.
+
+Values are anything with `.nbytes` (the COMPRESSED byte count for
+quantized/pruned entries) and `.bucket`: the cache accounts whatever is
+actually resident, which is exactly what makes a quantized tier worth
+having — the same byte budget holds tier-ratio more scenes.
 """
 
 from __future__ import annotations
@@ -23,16 +29,19 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any
 
-# (image_digest, checkpoint_step, H, W, S) — S is the engine bucket's
-# coarse plane count (its spec identity; c2f renders at coarse + fine)
-CacheKey = tuple[str, int, int, int, int]
+# (image_digest, checkpoint_step, H, W, S, tier) — S is the engine bucket's
+# coarse plane count (its spec identity; c2f renders at coarse + fine),
+# tier the compression tier the entry is stored at ("fp32"|"bf16"|"int8")
+CacheKey = tuple[str, int, int, int, int, str]
 
 
 def mpi_key(
-    image_digest: str, checkpoint_step: int, bucket: tuple[int, int, int]
+    image_digest: str, checkpoint_step: int, bucket: tuple[int, int, int],
+    tier: str = "fp32",
 ) -> CacheKey:
     h, w, s = bucket
-    return (image_digest, int(checkpoint_step), int(h), int(w), int(s))
+    return (image_digest, int(checkpoint_step), int(h), int(w), int(s),
+            str(tier))
 
 
 def key_to_str(key: CacheKey) -> str:
@@ -41,8 +50,17 @@ def key_to_str(key: CacheKey) -> str:
 
 
 def key_from_str(s: str) -> CacheKey:
-    digest, step, h, w, planes = s.rsplit(":", 4)
-    return (digest, int(step), int(h), int(w), int(planes))
+    parts = s.split(":")
+    if len(parts) == 5:
+        # pre-tier wire keys (a client that cached an mpi_key across a
+        # server upgrade): they named the then-only fp32 representation
+        digest, step, h, w, planes = parts
+        tier = "fp32"
+    elif len(parts) == 6:
+        digest, step, h, w, planes, tier = parts
+    else:
+        raise ValueError(f"malformed mpi_key {s!r}")
+    return (digest, int(step), int(h), int(w), int(planes), tier)
 
 
 def _nbytes(arr: Any) -> int:
@@ -77,7 +95,9 @@ class MPIEntry:
 
 
 class MPICache:
-    """Thread-safe LRU over MPIEntry values with byte-accounted eviction.
+    """Thread-safe LRU over MPIEntry/CompressedMPI values with
+    byte-accounted eviction (bytes = each value's own `.nbytes`, i.e. the
+    compressed size for quantized tiers).
 
     `get` refreshes recency; `put` evicts least-recently-used entries until
     the resident total fits the budget. A single entry larger than the whole
@@ -92,7 +112,7 @@ class MPICache:
             raise ValueError(f"byte_budget must be positive, got {byte_budget}")
         self.byte_budget = int(byte_budget)
         self._lock = threading.Lock()
-        self._entries: OrderedDict[CacheKey, MPIEntry] = OrderedDict()
+        self._entries: OrderedDict[CacheKey, Any] = OrderedDict()
         self._bytes = 0
         self._metrics = metrics
 
@@ -109,7 +129,7 @@ class MPICache:
         with self._lock:
             return list(self._entries)
 
-    def get(self, key: CacheKey, record: bool = True) -> MPIEntry | None:
+    def get(self, key: CacheKey, record: bool = True) -> Any | None:
         """Lookup + LRU touch. record=False skips the hit/miss counters —
         for internal re-checks (the predict singleflight's under-lock peek)
         that would otherwise double-count one logical request."""
@@ -124,7 +144,7 @@ class MPICache:
                 self._metrics.cache_misses.inc()
         return entry
 
-    def put(self, key: CacheKey, entry: MPIEntry) -> list[CacheKey]:
+    def put(self, key: CacheKey, entry: Any) -> list[CacheKey]:
         """Insert (or refresh) an entry; returns the keys evicted for it."""
         evicted: list[CacheKey] = []
         with self._lock:

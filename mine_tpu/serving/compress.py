@@ -1,0 +1,432 @@
+"""Compressed MPI representation: quantized tiers + transmittance pruning.
+
+A dense fp32 MPI is the serving stack's unit of cost: at 384x256 S=64 one
+cached entry is ~400 MB, so the byte-budgeted MPICache holds a handful of
+scenes and the fleet's digest-affinity routing concentrates hits onto
+capacity that is not there. "Compact and adaptive multiplane images"
+(arxiv 2102.10086) shows MPIs tolerate aggressive compaction with
+negligible PSNR loss; this module is that observation as a data type with
+three consumers:
+
+  the cache   `CompressedMPI` is a drop-in MPICache value (`.nbytes` is the
+              COMPRESSED byte count, so budget/eviction/gauges account what
+              is actually resident); the tier is part of every cache key
+              (serving/cache.py mpi_key), so fp32/bf16/int8 entries of one
+              image never alias.
+  the render  `decompress()` is dequant-on-render: the AOT render
+              executables stay fp32 pure functions, and the engine converts
+              the resident compressed slabs per dispatch (serving/engine.py
+              pads the surviving planes up to a pruned-plane-count
+              executable bucket — pruning cuts render FLOPs, not just
+              bytes).
+  the wire    `to_wire`/`from_wire` give a self-describing byte format a
+              replica serves over `GET /mpi/<key>` so a peer can adopt a
+              cached MPI instead of re-running the encoder — the compressed
+              representation is what makes shipping an MPI between replicas
+              cheaper than recomputing it.
+
+Tiers:
+  fp32   no transformation (with pruning off, `compress_mpi` returns the
+         plain MPIEntry unchanged — a numerics NO-OP, PARITY.md 5.11)
+  bf16   slabs stored as bfloat16 (ml_dtypes, a jax dependency): half the
+         bytes, ~2^-8 relative rounding
+  int8   per-plane-scaled AFFINE quantization of rgb and sigma: for each
+         plane, q = round((x - lo) / scale) - 128 stored as int8, with the
+         (lo, scale) pair carried per plane in fp32. One plane's dynamic
+         range cannot poison another's (a nearly-empty far plane quantizes
+         its tiny sigma range finely even when a near plane is opaque).
+
+Pruning: `ops/mpi_render.py plane_contributions` computes each plane's
+maximum compositing weight (accumulated transmittance x alpha — the same
+per-plane quantity the streaming compositor's scan carries, parallax-
+dilated so disocclusion content survives); planes that never reach
+`prune_eps` anywhere are dropped and the SURVIVING plane disparities
+travel with the slabs. Because the renderer re-derives inter-plane
+distances from the disparities it is handed, each survivor's sigma is
+rescaled by its old/new gap ratio (`_prune_sigma_scale`) so its
+transparency is preserved exactly at the source pose — without that, a
+kept plane in front of a pruned run would silently brighten.
+DEFAULT_PRUNE_EPS (1e-3) is the recommended operating point (PSNR within
+0.1 dB of unpruned on the eval scene, tests/test_compress.py gates it via
+the convergence harness's scorer).
+
+Everything here is host-side numpy (ml_dtypes for bf16) so the FakeEngine
+fleet tests exercise the identical code without an XLA compile; the real
+engine device_puts the compressed fields once after compression
+(RenderEngine._adopt_entry) and `decompress` is written against the array
+API surface numpy and jax share (astype/arithmetic), so dequant runs
+wherever the fields live.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+# cache.py imports nothing from this package, so the value types and the
+# one byte-accounting rule (`_nbytes`) are shared without a cycle
+from mine_tpu.serving.cache import MPIEntry, _nbytes
+
+TIERS = ("fp32", "bf16", "int8")
+
+# the recommended pruning threshold: a plane whose best pixel contributes
+# < 0.1% of a ray's color is invisible at 8-bit output depth; measured on
+# the eval scene it stays within 0.1 dB of the unpruned render
+# (tests/test_compress.py::test_tier_psnr_parity_on_eval_scene)
+DEFAULT_PRUNE_EPS = 1e-3
+
+_WIRE_MAGIC = b"MPIC1\n"
+
+
+def _bf16_dtype():
+    import ml_dtypes  # ships with jax
+
+    return ml_dtypes.bfloat16
+
+
+
+
+@dataclass
+class CompressedMPI:
+    """One compressed cached prediction: everything `decompress` needs to
+    hand the render executables fp32 slabs, nothing else.
+
+    rgb/sigma hold the tier's storage dtype ((1, S_kept, H, W, 3/1)):
+    fp32/bf16 directly, int8 alongside per-plane (lo, scale) fp32 pairs.
+    disparity is the SURVIVING planes' (1, S_kept) — pruning already
+    happened, the renderer never sees the dropped planes. bucket is the
+    engine shape-bucket identity (H, W, S_coarse) the entry was predicted
+    under; num_planes_full is the unpruned plane count (coarse+fine for
+    c2f buckets), kept for observability and the wire header.
+    """
+
+    tier: str
+    rgb: Any  # (1, S_kept, H, W, 3) storage dtype
+    sigma: Any  # (1, S_kept, H, W, 1) storage dtype
+    disparity: Any  # (1, S_kept) fp32
+    k: Any  # (1, 3, 3) fp32
+    bucket: tuple[int, int, int]
+    num_planes_full: int
+    rgb_lo: Any = None  # (1, S_kept, 1, 1, 1) fp32, int8 tier only
+    rgb_scale: Any = None
+    sigma_lo: Any = None
+    sigma_scale: Any = None
+    nbytes: int = field(default=0)
+
+    def __post_init__(self) -> None:
+        if self.tier not in TIERS:
+            raise ValueError(f"unknown tier {self.tier!r}; one of {TIERS}")
+        if not self.nbytes:
+            self.nbytes = sum(
+                _nbytes(a) for a in self._arrays().values() if a is not None
+            )
+
+    @property
+    def planes_kept(self) -> int:
+        return int(self.disparity.shape[1])
+
+    def _arrays(self) -> dict[str, Any]:
+        return {
+            "rgb": self.rgb, "sigma": self.sigma,
+            "disparity": self.disparity, "k": self.k,
+            "rgb_lo": self.rgb_lo, "rgb_scale": self.rgb_scale,
+            "sigma_lo": self.sigma_lo, "sigma_scale": self.sigma_scale,
+        }
+
+    def replace_arrays(self, mapped: dict[str, Any]) -> "CompressedMPI":
+        """A copy with array fields substituted (same nbytes — the engine
+        uses this to device_put the resident fields without re-deriving
+        byte accounting from device array types)."""
+        return CompressedMPI(
+            tier=self.tier, bucket=self.bucket,
+            num_planes_full=self.num_planes_full, nbytes=self.nbytes,
+            **mapped,
+        )
+
+
+def _quantize_int8(x: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-plane affine int8: (q, lo, scale) with x ~ (q + 128) * scale + lo.
+    x: (1, S, H, W, C). lo/scale: (1, S, 1, 1, 1) fp32."""
+    lo = x.min(axis=(2, 3, 4), keepdims=True).astype(np.float32)
+    hi = x.max(axis=(2, 3, 4), keepdims=True).astype(np.float32)
+    # a constant plane still round-trips exactly: scale 0 would divide by
+    # zero, so floor it and let lo carry the value
+    scale = np.maximum((hi - lo) / 255.0, 1e-12).astype(np.float32)
+    q = np.clip(np.round((x - lo) / scale), 0.0, 255.0) - 128.0
+    return q.astype(np.int8), lo, scale
+
+
+def _dequant_int8(q: Any, lo: Any, scale: Any) -> Any:
+    """Array-API-agnostic dequant (numpy in, numpy out; jax in, jax out)."""
+    return (q.astype(np.float32) + np.float32(128.0)) * scale + lo
+
+
+def _prune_sigma_scale(disparity: np.ndarray, keep: np.ndarray) -> np.ndarray:
+    """Per-surviving-plane sigma correction for pruning, (K,) fp32.
+
+    The renderer derives inter-plane distances from the disparity list it
+    is given: dist_s(q) = (depth_next - depth_s) * ||K^-1 q|| (the last
+    plane gets the background pseudo-distance). Dropping planes therefore
+    WIDENS the gap of any kept plane that preceded a pruned run, and its
+    alpha = 1 - exp(-sigma * dist) would inflate — a kept semi-transparent
+    plane could brighten severalfold. The ray norm cancels in the
+    old/new-gap ratio, so scaling each surviving plane's sigma by
+    orig_gap / new_gap preserves its transparency EXACTLY at the source
+    pose (and up to the warp's angular variation at novel poses —
+    bounded by the parity gate in tests/test_compress.py).
+
+    Exactness requires that no survivor be promoted into the LAST slot:
+    the background pseudo-distance is a CONSTANT (no ray-norm factor), so
+    a scalar could not compensate it — which is why compress_mpi always
+    keeps the original last plane in sigma mode. A plane that was already
+    last keeps its BG slot on both sides (ratio 1)."""
+    from mine_tpu.ops.mpi_render import _BG_DIST
+
+    depth = 1.0 / np.asarray(disparity, np.float64).reshape(-1)  # (S,)
+    s = depth.shape[0]
+    orig_gap = np.empty(s, np.float64)
+    orig_gap[:-1] = np.abs(depth[1:] - depth[:-1])
+    orig_gap[-1] = _BG_DIST
+    kept = np.flatnonzero(keep)
+    new_gap = np.empty(kept.shape[0], np.float64)
+    new_gap[:-1] = np.abs(depth[kept[1:]] - depth[kept[:-1]])
+    new_gap[-1] = _BG_DIST
+    return (orig_gap[kept] / np.maximum(new_gap, 1e-12)).astype(np.float32)
+
+
+def keep_mask(contributions: np.ndarray, prune_eps: float) -> np.ndarray:
+    """(S,) bool: planes whose max compositing weight reaches prune_eps.
+    The best plane is ALWAYS kept — an empty keep-set would leave nothing
+    to render, and an all-transparent MPI degrades to its least-empty
+    plane rather than an error."""
+    contributions = np.asarray(contributions, np.float64)
+    keep = contributions >= float(prune_eps)
+    if not keep.any():
+        keep[int(np.argmax(contributions))] = True
+    return keep
+
+
+def compress_mpi(
+    mpi_rgb: Any,
+    mpi_sigma: Any,
+    disparity: Any,
+    k: Any,
+    bucket: tuple[int, int, int],
+    tier: str = "fp32",
+    prune_eps: float = 0.0,
+    use_alpha: bool = False,
+):
+    """Predict output -> cache value. fp32 + pruning off returns the plain
+    MPIEntry (bitwise the input arrays — the numerics no-op the default
+    config promises); anything else returns a CompressedMPI.
+
+    Inputs may be device or host arrays; compression itself runs on host
+    numpy (one device_get per predict — the price of an order of magnitude
+    more cache capacity), and the caller re-places the result
+    (RenderEngine._adopt_entry).
+    """
+    if tier not in TIERS:
+        raise ValueError(f"unknown cache tier {tier!r}; one of {TIERS}")
+    if tier == "fp32" and not prune_eps:
+        return MPIEntry(
+            mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma, disparity=disparity, k=k,
+            bucket=tuple(bucket),
+        )
+
+    keep = None
+    if prune_eps:
+        # one source of truth for "contribution": the compositors' own
+        # per-plane weight (ops/mpi_render.py), evaluated eagerly — tiny
+        # elementwise graph, no AOT executable involved. Computed from the
+        # ORIGINAL inputs BEFORE the host pull below: on a real engine the
+        # predict outputs are still device-resident, so the reduction runs
+        # on device and only the (S,) vector crosses — not a wasted
+        # D2H + H2D round trip of the whole sigma slab.
+        from mine_tpu.ops import inverse_3x3, plane_contributions
+
+        contrib = np.asarray(plane_contributions(
+            mpi_sigma, disparity, inverse_3x3(k), use_alpha=use_alpha,
+        ))
+        keep = keep_mask(contrib, prune_eps)
+        if not use_alpha:
+            # the renderer's background slot is a CONSTANT pseudo-distance
+            # (ray norms scale only the interior gaps — _src_dists), so a
+            # survivor PROMOTED into the last slot could not be compensated
+            # by a per-plane scalar. Keeping the original last plane means
+            # every widened gap stays interior-to-interior, where the ray
+            # norm cancels and the sigma rescale is exact. One plane of
+            # bytes buys exactness.
+            keep[-1] = True
+        if tier == "fp32" and keep.all():
+            # nothing to prune and nothing to quantize: the original
+            # (device) arrays ARE the entry — skip the pointless
+            # full-slab D2H + H2D round trip below
+            return MPIEntry(
+                mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma,
+                disparity=disparity, k=k, bucket=tuple(bucket),
+            )
+
+    rgb = np.asarray(mpi_rgb, np.float32)
+    sigma = np.asarray(mpi_sigma, np.float32)
+    disp = np.asarray(disparity, np.float32)
+    k_host = np.asarray(k, np.float32)
+    num_full = rgb.shape[1]
+
+    if keep is not None:
+        if not keep.all():
+            if not use_alpha:
+                # preserve each survivor's transparency under its widened
+                # inter-plane gap (see _prune_sigma_scale); alpha-mode
+                # composites sigma directly, no distance, no correction
+                scale = _prune_sigma_scale(disp, keep)
+                sigma = sigma[:, keep] * scale[None, :, None, None, None]
+            else:
+                sigma = sigma[:, keep]
+            rgb = rgb[:, keep]
+            disp = disp[:, keep]
+
+    fields: dict[str, Any] = {}
+    if tier == "fp32":
+        fields.update(rgb=rgb, sigma=sigma)
+    elif tier == "bf16":
+        bf16 = _bf16_dtype()
+        fields.update(rgb=rgb.astype(bf16), sigma=sigma.astype(bf16))
+    else:  # int8
+        q_rgb, rgb_lo, rgb_scale = _quantize_int8(rgb)
+        q_sigma, sigma_lo, sigma_scale = _quantize_int8(sigma)
+        fields.update(
+            rgb=q_rgb, sigma=q_sigma,
+            rgb_lo=rgb_lo, rgb_scale=rgb_scale,
+            sigma_lo=sigma_lo, sigma_scale=sigma_scale,
+        )
+    return CompressedMPI(
+        tier=tier, disparity=disp, k=k_host, bucket=tuple(bucket),
+        num_planes_full=int(num_full), **fields,
+    )
+
+
+def decompress(entry: CompressedMPI) -> tuple[Any, Any, Any, Any]:
+    """CompressedMPI -> (rgb fp32, sigma fp32, disparity, k), the render
+    executables' input contract. Written against the array surface numpy
+    and jax share, so device-resident fields dequantize on device (the
+    dequant IS the render-path cost of the tier) and host fields stay
+    host-side (FakeEngine)."""
+    if entry.tier == "int8":
+        rgb = _dequant_int8(entry.rgb, entry.rgb_lo, entry.rgb_scale)
+        sigma = _dequant_int8(entry.sigma, entry.sigma_lo, entry.sigma_scale)
+    else:  # fp32 passthrough / bf16 upcast
+        rgb = entry.rgb.astype(np.float32)
+        sigma = entry.sigma.astype(np.float32)
+    return rgb, sigma, entry.disparity, entry.k
+
+
+# -- wire format --------------------------------------------------------------
+#
+# One self-describing blob: magic, a JSON header (tier, bucket, plane
+# counts, and per-field shape/dtype), then the raw little-endian buffers in
+# header order. Plain MPIEntry values serialize as the fp32 tier, so a
+# peer fetch works whatever tier the owner runs (the tier-qualified key
+# means homogeneous fleets only ever exchange their own tier).
+
+
+def to_wire(entry: Any) -> bytes:
+    """MPIEntry | CompressedMPI -> bytes (the GET /mpi/<key> body)."""
+    if isinstance(entry, MPIEntry):
+        entry = CompressedMPI(
+            tier="fp32",
+            rgb=np.asarray(entry.mpi_rgb, np.float32),
+            sigma=np.asarray(entry.mpi_sigma, np.float32),
+            disparity=np.asarray(entry.disparity, np.float32),
+            k=np.asarray(entry.k, np.float32),
+            bucket=tuple(entry.bucket),
+            num_planes_full=int(np.shape(entry.mpi_rgb)[1]),
+        )
+    # materialize each field off-device ONCE — an MPI slab is the whole
+    # payload, and a second np.asarray would double the D2H transfer the
+    # peer-fetch timeout budgets for
+    arrays = {
+        n: np.ascontiguousarray(np.asarray(a))
+        for n, a in entry._arrays().items() if a is not None
+    }
+    header = {
+        "tier": entry.tier,
+        "bucket": list(entry.bucket),
+        "num_planes_full": entry.num_planes_full,
+        "fields": {
+            name: {"shape": list(a.shape), "dtype": str(a.dtype)}
+            for name, a in arrays.items()
+        },
+    }
+    buf = io.BytesIO()
+    head = json.dumps(header).encode()
+    buf.write(_WIRE_MAGIC)
+    buf.write(len(head).to_bytes(8, "little"))
+    buf.write(head)
+    for name in header["fields"]:
+        buf.write(arrays[name].tobytes())
+    return buf.getvalue()
+
+
+def from_wire(data: bytes) -> Any:
+    """bytes -> MPIEntry (fp32 full) | CompressedMPI. Validates structure
+    and sizes: a truncated/garbled peer response raises ValueError (the
+    fetcher counts it as an error outcome and re-predicts locally)."""
+    if not data.startswith(_WIRE_MAGIC):
+        raise ValueError("not an MPI wire blob (bad magic)")
+    off = len(_WIRE_MAGIC)
+    if len(data) < off + 8:
+        raise ValueError("truncated MPI wire blob (no header length)")
+    head_len = int.from_bytes(data[off:off + 8], "little")
+    off += 8
+    if head_len <= 0 or head_len > 1 << 20 or len(data) < off + head_len:
+        raise ValueError("truncated MPI wire blob (bad header length)")
+    header = json.loads(data[off:off + head_len])
+    off += head_len
+    tier = header["tier"]
+    if tier not in TIERS:
+        raise ValueError(f"unknown wire tier {tier!r}")
+    arrays: dict[str, np.ndarray] = {}
+    for name, spec in header["fields"].items():
+        shape = tuple(int(v) for v in spec["shape"])
+        dtype = (np.dtype(_bf16_dtype()) if spec["dtype"] == "bfloat16"
+                 else np.dtype(spec["dtype"]))
+        count = int(np.prod(shape)) if shape else 1
+        nbytes = count * dtype.itemsize
+        if len(data) < off + nbytes:
+            raise ValueError(f"truncated MPI wire blob (field {name})")
+        # frombuffer straight off the blob at an offset + one .copy(): a
+        # bytes slice first would transiently double a multi-hundred-MB
+        # slab inside the peer-fetch budget (same discipline as to_wire)
+        arrays[name] = np.frombuffer(
+            data, dtype=dtype, count=count, offset=off
+        ).reshape(shape).copy()
+        off += nbytes
+    required = {"rgb", "sigma", "disparity", "k"}
+    if tier == "int8":
+        # a blob missing the quantization sidecars would dequantize into
+        # None.astype at RENDER time — the poisoned-cache failure class
+        # the adoption fence exists to prevent; refuse it at parse time
+        required |= {"rgb_lo", "rgb_scale", "sigma_lo", "sigma_scale"}
+    missing = required - set(arrays)
+    if missing:
+        raise ValueError(
+            f"MPI wire blob (tier {tier}) missing fields {sorted(missing)}"
+        )
+    bucket = tuple(int(v) for v in header["bucket"])
+    num_full = int(header["num_planes_full"])
+    if tier == "fp32" and arrays["rgb"].shape[1] == num_full:
+        return MPIEntry(
+            mpi_rgb=arrays["rgb"], mpi_sigma=arrays["sigma"],
+            disparity=arrays["disparity"], k=arrays["k"], bucket=bucket,
+        )
+    return CompressedMPI(
+        tier=tier, bucket=bucket, num_planes_full=num_full,
+        rgb=arrays["rgb"], sigma=arrays["sigma"],
+        disparity=arrays["disparity"], k=arrays["k"],
+        rgb_lo=arrays.get("rgb_lo"), rgb_scale=arrays.get("rgb_scale"),
+        sigma_lo=arrays.get("sigma_lo"), sigma_scale=arrays.get("sigma_scale"),
+    )

@@ -46,6 +46,12 @@ from mine_tpu.obs.cost import StepCost, compiled_cost, resolve_peak_flops
 from mine_tpu.obs.trace import NULL_TRACER, Tracer
 from mine_tpu.resilience import chaos
 from mine_tpu.serving.cache import MPIEntry
+from mine_tpu.serving.compress import (
+    TIERS,
+    CompressedMPI,
+    compress_mpi,
+    decompress,
+)
 from mine_tpu.utils.compile_cache import enable_persistent_compile_cache
 
 BucketSpec = tuple[int, int, int]  # (H, W, S_coarse)
@@ -120,12 +126,33 @@ class _Bucket:
         self.disparity = make_disparity_list(fixed, jax.random.PRNGKey(0), 1)
         self.k = jnp.asarray(fov_intrinsics(h, w, engine.fov_deg))[None]
         self._predict_exec = None
-        self._render_execs: dict[int, Any] = {}
+        # render executables keyed (n_planes, n_poses): transmittance
+        # pruning (serving/compress.py) makes the plane count variable, so
+        # pruned renders run a pruned-plane-count bucket — fewer planes is
+        # a genuinely cheaper executable (the FLOPs cut shows up in its
+        # StepCost), and the bucket set stays finite: plane_count_buckets x
+        # pose_buckets
+        self._render_execs: dict[tuple[int, int], Any] = {}
         # XLA cost analysis per executable (obs/cost.py), captured at
         # compile time — what the /metrics MFU gauge divides by step time
         self.predict_cost: StepCost | None = None
-        self.render_costs: dict[int, StepCost] = {}
+        self.render_costs: dict[tuple[int, int], StepCost] = {}
+        # pruned-plane executable buckets: powers of two under the full
+        # count, plus the full count itself — log2(S) extra shapes at most,
+        # compiled lazily only when pruning actually produces that bucket
+        self.plane_buckets: tuple[int, ...] = tuple(sorted(
+            {self.num_planes}
+            | {1 << p for p in range(1, self.num_planes.bit_length())
+               if (1 << p) < self.num_planes}
+        ))
         self._lock = threading.Lock()
+
+    def plane_bucket(self, n_planes: int) -> int:
+        """Smallest plane-count executable bucket >= n_planes."""
+        for b in self.plane_buckets:
+            if n_planes <= b:
+                return b
+        return self.plane_buckets[-1]
 
     # -- executables ---------------------------------------------------------
 
@@ -168,19 +195,20 @@ class _Bucket:
                 self.engine._count_compile("predict")
             return self._predict_exec
 
-    def render_executable(self, n_poses: int):
+    def render_executable(self, n_poses: int, n_planes: int | None = None):
         import jax
 
         from mine_tpu.inference.video import render_many_fn
 
-        exe = self._render_execs.get(n_poses)
+        s = self.num_planes if n_planes is None else int(n_planes)
+        key = (s, n_poses)
+        exe = self._render_execs.get(key)
         if exe is not None:
             return exe
         with self._lock:
-            exe = self._render_execs.get(n_poses)
+            exe = self._render_execs.get(key)
             if exe is None:
                 h, w, _ = self.spec
-                s = self.num_planes
                 donate = self.engine._donate((5,))
                 fn = jax.jit(render_many_fn, static_argnums=0, **donate)
                 lowered = fn.lower(
@@ -192,8 +220,8 @@ class _Bucket:
                     jax.ShapeDtypeStruct((n_poses, 4, 4), np.float32),
                 )
                 exe = lowered.compile()
-                self.render_costs[n_poses] = compiled_cost(exe)
-                self._render_execs[n_poses] = exe
+                self.render_costs[key] = compiled_cost(exe)
+                self._render_execs[key] = exe
                 self.engine._count_compile("render")
             return exe
 
@@ -218,6 +246,8 @@ class RenderEngine:
         compositor: str = "streaming",
         peak_flops_override: float = 0.0,
         tracer: Tracer | None = None,
+        cache_tier: str | None = None,
+        prune_eps: float | None = None,
     ):
         import jax
 
@@ -225,6 +255,28 @@ class RenderEngine:
 
         enable_persistent_compile_cache()
         self.base_cfg = cfg
+        # compressed-MPI knobs (serving/compress.py): ctor args override the
+        # serving.* config group. Validated here so a typo'd tier fails at
+        # startup, not inside the first predict's compression.
+        self.cache_tier = (cfg.serving.cache_tier if cache_tier is None
+                           else cache_tier)
+        if self.cache_tier not in TIERS:
+            raise ValueError(
+                f"serving.cache_tier={self.cache_tier!r} must be one of "
+                f"{TIERS}"
+            )
+        self.prune_eps = float(
+            cfg.serving.prune_transmittance_eps if prune_eps is None
+            else prune_eps
+        )
+        if not 0.0 <= self.prune_eps < 1.0:
+            # a compositing weight never reaches 1.0, so eps >= 1 (the
+            # classic 1e3-for-1e-3 typo) would silently collapse every
+            # cached MPI to its single best plane — fail at startup instead
+            raise ValueError(
+                f"serving.prune_transmittance_eps={self.prune_eps} must be "
+                "in [0, 1) — it thresholds a compositing weight"
+            )
         # Serving defaults to the STREAMING compositor regardless of the
         # checkpoint's training-time knob: render-many never materializes
         # the warped (N_poses, S, H, W, C) slabs, so the resident-MPI render
@@ -493,8 +545,10 @@ class RenderEngine:
         self, image: np.ndarray, spec: BucketSpec | None = None,
         request_id: str | None = None,
         weights: WeightSet | None = None,
-    ) -> MPIEntry:
-        """Run the encoder-decoder once; returns a device-resident MPIEntry.
+    ) -> MPIEntry | CompressedMPI:
+        """Run the encoder-decoder once; returns the device-resident cache
+        value at the engine's tier — a plain MPIEntry at fp32 with pruning
+        off (the numerics no-op), a CompressedMPI otherwise.
 
         image: (h, w, 3) uint8 or float in [0, 1] at any resolution — it is
         resized to the bucket's (H, W) exactly like the one-shot CLI
@@ -518,21 +572,93 @@ class RenderEngine:
             mpi_rgb, mpi_sigma, disparity = self._dispatch_predict(
                 bucket, img, ws.variables
             )
+            entry = self._compress(bucket, mpi_rgb, mpi_sigma, disparity)
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
             if bucket.predict_cost is not None and bucket.predict_cost.flops:
                 self.metrics.step_flops.set(
                     bucket.predict_cost.flops, kind="predict"
                 )
-        return MPIEntry(
-            mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma, disparity=disparity,
-            k=bucket.k, bucket=bucket.spec,
+        return entry
+
+    def _compress(self, bucket: _Bucket, mpi_rgb, mpi_sigma, disparity):
+        """Predict output -> cache value at the engine's tier/prune knobs.
+        The fp32 + pruning-off fast path is a numerics no-op: the device
+        arrays the executable produced ARE the entry (PARITY.md 5.11);
+        otherwise compression runs host-side (one device_get per predict)
+        and the compressed fields are re-placed on device."""
+        entry = compress_mpi(
+            mpi_rgb, mpi_sigma, disparity, bucket.k, bucket=bucket.spec,
+            tier=self.cache_tier, prune_eps=self.prune_eps,
+            use_alpha=bucket.cfg.mpi.use_alpha,
         )
+        if (self.metrics is not None and isinstance(entry, CompressedMPI)
+                and entry.planes_kept < entry.num_planes_full):
+            self.metrics.pruned_planes.inc(
+                entry.num_planes_full - entry.planes_kept
+            )
+        return self._adopt_entry(entry)
+
+    def _adopt_entry(self, entry):
+        """Make a cache value (fresh from _compress, or fetched off a
+        peer's wire) device-resident, exactly like startup device_puts the
+        weights: a host-numpy slab fed to a compiled executable would
+        re-transfer on EVERY render. nbytes is unchanged — byte accounting
+        is a property of the representation, not of where it lives."""
+        import jax
+
+        if isinstance(entry, CompressedMPI):
+            return entry.replace_arrays({
+                name: None if a is None else jax.device_put(a)
+                for name, a in entry._arrays().items()
+            })
+        if isinstance(entry.mpi_rgb, np.ndarray):  # peer-fetched fp32 entry
+            return MPIEntry(
+                mpi_rgb=jax.device_put(entry.mpi_rgb),
+                mpi_sigma=jax.device_put(entry.mpi_sigma),
+                disparity=jax.device_put(entry.disparity),
+                k=jax.device_put(entry.k),
+                bucket=entry.bucket, nbytes=entry.nbytes,
+            )
+        return entry
+
+    def _render_inputs(self, bucket: _Bucket, entry):
+        """Cache value -> (rgb, sigma, disparity, k, n_planes) fp32 render
+        inputs. Compressed entries dequantize here (dequant-on-render) and
+        their surviving planes pad up to a plane-count executable bucket:
+        prepended planes reuse the nearest surviving disparity with
+        sigma == 0, so alpha is exactly 0 and they contribute nothing —
+        the only deviation is the compositor's +1e-6 cumprod epsilon per
+        pad plane, orders of magnitude under the quantization tolerance."""
+        import jax.numpy as jnp
+
+        if not isinstance(entry, CompressedMPI):
+            return (entry.mpi_rgb, entry.mpi_sigma, entry.disparity,
+                    entry.k, bucket.num_planes)
+        rgb, sigma, disparity, k = decompress(entry)
+        kept = entry.planes_kept
+        n_planes = bucket.plane_bucket(kept)
+        if kept < n_planes:
+            pad = n_planes - kept
+            _, _, h, w, _ = rgb.shape
+            rgb = jnp.concatenate(
+                [jnp.zeros((1, pad, h, w, 3), jnp.float32), rgb], axis=1
+            )
+            sigma = jnp.concatenate(
+                [jnp.zeros((1, pad, h, w, 1), jnp.float32), sigma], axis=1
+            )
+            disparity = jnp.concatenate(
+                [jnp.broadcast_to(disparity[:, :1], (1, pad)), disparity],
+                axis=1,
+            )
+        return rgb, sigma, disparity, k, n_planes
 
     def render(
-        self, entry: MPIEntry, poses: np.ndarray
+        self, entry: Any, poses: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Render (N, 4, 4) G_tgt_src poses against a cached MPI.
+        """Render (N, 4, 4) G_tgt_src poses against a cached MPI
+        (MPIEntry or CompressedMPI — compressed entries dequantize per
+        dispatch and run a pruned-plane-count executable bucket).
 
         Pads N up to the next pose bucket (identity poses, discarded) and
         runs that bucket's executable; N beyond the largest bucket chunks
@@ -551,6 +677,9 @@ class RenderEngine:
             return (np.zeros((0, h, w, 3), np.float32),
                     np.zeros((0, h, w, 1), np.float32))
         bucket = self.bucket(entry.bucket)
+        mpi_rgb, mpi_sigma, disparity, k, n_planes = self._render_inputs(
+            bucket, entry
+        )
         max_b = self.pose_buckets[-1]
         rgb_parts, disp_parts = [], []
         total_flops = 0.0
@@ -565,14 +694,14 @@ class RenderEngine:
                 padded = np.concatenate([chunk, pad], axis=0)
             else:
                 padded = chunk
-            exe = bucket.render_executable(nb)
+            exe = bucket.render_executable(nb, n_planes)
             rgb, disp = exe(
-                entry.mpi_rgb, entry.mpi_sigma, entry.disparity, entry.k,
+                mpi_rgb, mpi_sigma, disparity, k,
                 jax.numpy.asarray(padded),
             )
             rgb_parts.append(np.asarray(jax.device_get(rgb))[:chunk.shape[0]])
             disp_parts.append(np.asarray(jax.device_get(disp))[:chunk.shape[0]])
-            cost = bucket.render_costs.get(nb)
+            cost = bucket.render_costs.get((n_planes, nb))
             if cost is not None and cost.flops:
                 total_flops += cost.flops
         elapsed = time.perf_counter() - t0
@@ -601,12 +730,21 @@ class RenderEngine:
     ) -> int:
         """Compile the expected executable set before taking traffic
         (persisted by the XLA compile cache across restarts). Returns the
-        number of executables built by this call."""
+        number of executables built by this call.
+
+        With pruning on, a render may land on ANY pruned-plane-count
+        bucket, so those executables are part of the expected set too —
+        otherwise the first live render of each (planes, poses) pair would
+        pay a blocking compile on the request path, the cold start warmup
+        exists to avoid. log2(S) x pose buckets, bounded."""
         before = self.compiles
         for spec in (specs if specs is not None else [self.default_bucket]):
             bucket = self.bucket(spec)
             bucket.predict_executable()
+            plane_counts = (bucket.plane_buckets if self.prune_eps
+                            else (bucket.num_planes,))
             for nb in (pose_counts if pose_counts is not None
                        else self.pose_buckets):
-                bucket.render_executable(self._pose_bucket(nb))
+                for n_planes in plane_counts:
+                    bucket.render_executable(self._pose_bucket(nb), n_planes)
         return self.compiles - before

@@ -22,10 +22,25 @@ A fake render fills every frame with a constant derived from the MPI's
 fill value, which `predict` derives from the generation's checkpoint
 step — so an end-to-end test can read a rendered pixel and know which
 weight generation produced it.
+
+The fake slabs are digest-seeded and NON-constant: sigma carries a
+randomly placed fronto-parallel "surface" (a Gaussian plane profile with a
+low-frequency spatial bump), so the transmittance distribution looks like
+a real scene's — planes in front of the surface are nearly transparent,
+planes behind it occluded. That is what lets the compression-ratio and
+transmittance-pruning paths (serving/compress.py) be exercised end to end
+without an XLA compile: a constant slab would quantize to nothing and
+prune to one plane, proving nothing. The generation marker survives
+compression: every plane's corner pixel (0, 0) channel 0 carries the fill
+value, so `render` can recover it even from a pruned entry (whose first
+planes may be gone) — exactly under the lossless fp32/bf16 tiers, and to
+within the per-plane quantization step (~1e-3 of the slab's range) under
+int8.
 """
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Any, Callable
 
@@ -34,6 +49,7 @@ import numpy as np
 from mine_tpu.config import Config
 from mine_tpu.resilience import chaos
 from mine_tpu.serving.cache import MPIEntry
+from mine_tpu.serving.compress import CompressedMPI, decompress
 from mine_tpu.serving.engine import RenderEngine, WeightSet
 
 
@@ -88,38 +104,75 @@ class FakeEngine(RenderEngine):
         # partition rule by design)
         return {"params": params, "batch_stats": batch_stats}
 
+    def _adopt_entry(self, entry):
+        # compressed entries stay host numpy too: the fake render
+        # decompresses in numpy, so device placement would only add a
+        # backend dependency the fake exists to avoid
+        return entry
+
     def _dispatch_predict(self, bucket, img, variables):
         if self.predict_delay_s:
             time.sleep(self.predict_delay_s)
         h, w, _ = bucket.spec
         s = bucket.num_planes
         fill = float(np.asarray(variables["params"]["w"]).flat[0])
+        # digest-seeded scene: the same image always produces the same
+        # slabs (cache/affinity tests stay deterministic), different
+        # images produce different transmittance distributions
+        seed = int.from_bytes(hashlib.sha256(
+            np.ascontiguousarray(np.asarray(img)).tobytes()
+        ).digest()[:8], "big")
+        rng = np.random.default_rng(seed)
+        planes = np.arange(s, dtype=np.float32)
+        # a fronto-parallel surface at a random depth: sigma peaks on its
+        # plane(s) and decays fast — in FRONT of it alpha is tiny (prunable
+        # planes), BEHIND it the accumulated transmittance is ~0 (occluded,
+        # also prunable); the surface band itself is opaque. The spatial
+        # bump gives quantization per-pixel structure to preserve.
+        surface = float(rng.uniform(0.25, 0.75)) * max(s - 1, 1)
+        width = max(s / 8.0, 0.75)
+        profile = np.exp(-(((planes - surface) / width) ** 2))
+        yy, xx = np.meshgrid(np.linspace(0.0, 1.0, h),
+                             np.linspace(0.0, 1.0, w), indexing="ij")
+        bump = 0.5 + 0.5 * np.sin(
+            2.0 * np.pi * (xx * rng.uniform(1.0, 3.0)
+                           + yy * rng.uniform(1.0, 3.0) + rng.uniform())
+        )
+        mpi_sigma = (
+            8.0 * profile[None, :, None, None, None]
+            * (0.25 + 0.75 * bump[None, None, :, :, None])
+        ).astype(np.float32)
         # rgb encodes the producing generation's step (clipped to [0, 1]
-        # at render time); sigma dense enough that frames aren't empty
-        mpi_rgb = np.full((1, s, h, w, 3), fill, np.float32)
-        mpi_sigma = np.full((1, s, h, w, 1), 5.0, np.float32)
+        # at render time) under low-amplitude texture; EVERY plane's
+        # (0, 0) corner channel 0 is exactly `fill`, so the marker
+        # survives plane pruning
+        mpi_rgb = (
+            fill + 0.05 * rng.standard_normal((1, s, h, w, 3))
+        ).astype(np.float32)
+        mpi_rgb[0, :, 0, 0, 0] = fill
         disparity = np.linspace(1.0, 0.01, s, dtype=np.float32)[None]
         return mpi_rgb, mpi_sigma, disparity
 
     def predict(
         self, image: np.ndarray, spec=None, request_id: str | None = None,
         weights: WeightSet | None = None,
-    ) -> MPIEntry:
+    ) -> MPIEntry | CompressedMPI:
         chaos.maybe_raise("predict_raise")  # same seam as the real engine
         ws = weights if weights is not None else self._weights
         bucket = self.bucket(spec)
         mpi_rgb, mpi_sigma, disparity = self._dispatch_predict(
             bucket, image, ws.variables
         )
+        # the REAL compression path (tier + transmittance pruning) over the
+        # fake slabs — compression-ratio/pruning behavior is exercised
+        # compile-free, and _adopt_entry keeps everything host numpy
+        entry = self._compress(bucket, mpi_rgb, mpi_sigma, disparity)
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
-        return MPIEntry(
-            mpi_rgb=mpi_rgb, mpi_sigma=mpi_sigma, disparity=disparity,
-            k=np.eye(3, dtype=np.float32)[None], bucket=bucket.spec,
-        )
+        return entry
 
     def render(
-        self, entry: MPIEntry, poses: np.ndarray
+        self, entry: Any, poses: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray]:
         chaos.maybe_raise("engine_raise")  # same seam as the real engine
         poses = np.asarray(poses, np.float32)
@@ -129,7 +182,12 @@ class FakeEngine(RenderEngine):
             time.sleep(self.render_delay_s)
         n = poses.shape[0]
         h, w, _ = entry.bucket
-        fill = float(np.clip(np.asarray(entry.mpi_rgb).flat[0], 0.0, 1.0))
+        if isinstance(entry, CompressedMPI):
+            rgb_slab = np.asarray(decompress(entry)[0])  # numpy dequant
+        else:
+            rgb_slab = np.asarray(entry.mpi_rgb)
+        # the generation marker: the first surviving plane's corner pixel
+        fill = float(np.clip(rgb_slab[0, 0, 0, 0, 0], 0.0, 1.0))
         rgb = np.full((n, h, w, 3), fill, np.float32)
         disp = np.full((n, h, w, 1), 0.5, np.float32)
         if self.metrics is not None:

@@ -512,7 +512,9 @@ def digest_of_request(path: str, body: bytes,
     computes for its cache key, so the ring sends repeats of one image to
     one replica. /render: the digest component of the mpi_key (minted by a
     /predict this router routed, so it lands on the replica holding the
-    MPI)."""
+    MPI). /mpi/<key>: the key's digest — the compressed-container fetch
+    (serving/compress.py wire) routes to the owner exactly like the
+    renders that hit its cache."""
     if path == "/predict":
         if content_type == "application/json":
             req = json.loads(body)
@@ -526,6 +528,8 @@ def digest_of_request(path: str, body: bytes,
         req = json.loads(body)
         digest = str(req["mpi_key"]).split(":", 1)[0]
         return digest, _float_or_none(req.get("timeout_s"))
+    if path.startswith("/mpi/") and len(path) > len("/mpi/"):
+        return path[len("/mpi/"):].split(":", 1)[0], None
     raise ValueError(f"unroutable path {path}")
 
 
@@ -598,14 +602,17 @@ class _FleetHandler(BaseHTTPRequestHandler):
             return 200 if ok else 422, "admin_swap"
         if method == "POST" and path in ("/predict", "/render"):
             return self._forward(app, path), path.lstrip("/")
+        if method == "GET" and path.startswith("/mpi/"):
+            # compressed-MPI fetch routes to the key's owner like a render
+            return self._forward(app, path, method="GET"), "mpi"
         self._send_json(404, {"error": f"no route {method} {path}"})
         return 404, "unknown"
 
-    def _forward(self, app: FleetApp, path: str) -> int:
-        body = self._read_body()
+    def _forward(self, app: FleetApp, path: str, method: str = "POST") -> int:
+        body = self._read_body() if method == "POST" else None
         ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
         try:
-            digest, timeout_s = digest_of_request(path, body, ctype)
+            digest, timeout_s = digest_of_request(path, body or b"", ctype)
         except (ValueError, KeyError, TypeError) as exc:
             self._send_json(400, {"error": f"unroutable request: {exc}"})
             return 400
@@ -615,7 +622,7 @@ class _FleetHandler(BaseHTTPRequestHandler):
         }
         try:
             status, resp_headers, resp_body, replica = app.forward(
-                digest, "POST", path, body, headers, timeout_s=timeout_s
+                digest, method, path, body, headers, timeout_s=timeout_s
             )
         except NoHealthyReplica as exc:
             retry_after = max(exc.retry_after_s, 0.1)

@@ -178,6 +178,27 @@ class ServingMetrics:
             "and bucket set must stay under",
         )
 
+        # compressed MPI tier (serving/compress.py)
+        self.pruned_planes = r.counter(
+            "mine_serve_pruned_planes_total",
+            "planes dropped from cached MPIs by transmittance pruning "
+            "(serving.prune_transmittance_eps) — each one is cache bytes "
+            "AND render FLOPs that no longer exist",
+        )
+        # fleet peer fetch (serving/server.py _peer_fetch): on a local
+        # cache miss a replica asks the ring's owner for the compressed
+        # MPI before re-running the encoder. Named mine_fleet_* because it
+        # is fleet-wire traffic, even though the counter lives on the
+        # replica that fetched.
+        self.peer_fetch = r.counter(
+            "mine_fleet_peer_fetch_total",
+            "peer MPI fetch attempts by outcome (hit = adopted a peer's "
+            "cached MPI, zero local encoder cost; miss = owner answered "
+            "404; incompatible = the peer runs a different pruning "
+            "operating point, config drift surfaced; timeout/error = "
+            "degraded to a local re-predict)",
+        )
+
         # MPI cache
         self.cache_hits = r.counter(
             "mine_serve_cache_hits_total", "MPI cache hits")

@@ -17,6 +17,10 @@ Endpoints:
                   -> {"frames_png_b64": [...], ...}. 404 when the MPI fell
                   out of the cache (client re-predicts). Concurrent renders
                   of one MPI coalesce into one dispatch (batcher.py).
+  GET  /mpi/<key> the cached MPI as its compressed wire container
+                  (serving/compress.py to_wire) — the fleet peer-fetch
+                  surface: on a local cache miss a peer replica adopts this
+                  instead of re-running the encoder. 404 when not resident.
   GET  /healthz   liveness + engine/bucket/cache snapshot (including the
                   serving weight generation + swap state).
   GET  /metrics   Prometheus text exposition (serving/metrics.py names).
@@ -82,6 +86,7 @@ from mine_tpu.serving.batcher import (
     QueueFull,
 )
 from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
+from mine_tpu.serving.compress import CompressedMPI, from_wire, to_wire
 from mine_tpu.serving.engine import (
     BucketSpec,
     RenderEngine,
@@ -161,6 +166,9 @@ class ServingApp:
         breaker_reset_s: float | None = None,
         engine: RenderEngine | None = None,
         swap_source: Any = None,
+        peers: dict[str, str] | None = None,
+        peer_name: str | None = None,
+        peer_fetch_timeout_s: float | None = None,
     ):
         res = cfg.resilience  # ctor args override the resilience.* knobs
 
@@ -232,6 +240,31 @@ class ServingApp:
         self.allowed_buckets: set[BucketSpec] = {self.engine.default_bucket}
         for spec in allowed_buckets or ():
             self.allowed_buckets.add(tuple(int(v) for v in spec))
+        # fleet peer fetch (the compressed wire's consumer): `peers` is the
+        # FULL fleet membership {name: base_url} including this replica,
+        # `peer_name` which one we are. On a local cache miss, predict asks
+        # the replicas MORE authoritative than us for this digest (earlier
+        # in the consistent-hash candidate order — after a membership
+        # change the previous owner is exactly there) for the compressed
+        # MPI before paying an encoder pass. Bounded by
+        # serving.peer_fetch_timeout_s per attempt; every failure mode
+        # degrades to the local predict, never an error.
+        self.peer_fetch_timeout_s = knob(
+            peer_fetch_timeout_s, cfg.serving.peer_fetch_timeout_s
+        )
+        if self.peer_fetch_timeout_s <= 0:
+            # same fail-fast contract as the engine's serving.* knobs: a
+            # zero/negative budget would make every _peer_fetch deadline
+            # already-expired — peer fetch silently off, no counter ever
+            # ticking, every relocated miss paying the encoder again
+            raise ValueError(
+                f"serving.peer_fetch_timeout_s={self.peer_fetch_timeout_s} "
+                "must be > 0"
+            )
+        self.peers: dict[str, str] = {}
+        self.peer_name = None
+        self._peer_ring = None
+        self.configure_peers(peers, peer_name)
         self.cache = MPICache(cache_bytes, metrics=self.metrics)
         self.batcher = MicroBatcher(
             self._guarded_render, max_delay_ms=max_delay_ms,
@@ -454,7 +487,8 @@ class ServingApp:
         # checkpoint_step and variables separately could straddle a hot swap
         # and file a new-generation MPI under the old generation's key
         weights = self.engine.weights()
-        key = mpi_key(digest, weights.checkpoint_step, bucket.spec)
+        key = mpi_key(digest, weights.checkpoint_step, bucket.spec,
+                      self.engine.cache_tier)
 
         def response(entry, cached: bool) -> dict:
             return {
@@ -462,6 +496,10 @@ class ServingApp:
                 "cached": cached,
                 "bucket": list(bucket.spec),
                 "planes": bucket.num_planes,
+                "planes_kept": (entry.planes_kept
+                                if isinstance(entry, CompressedMPI)
+                                else bucket.num_planes),
+                "tier": self.engine.cache_tier,
                 "mpi_bytes": entry.nbytes,
             }
 
@@ -494,14 +532,33 @@ class ServingApp:
                     f"predict singleflight wait exceeded "
                     f"{self.request_timeout_s}s"
                 ) from None
+        from_peer = False
         try:
-            # decode OUTSIDE the breaker guard: undecodable bytes are the
-            # client's fault (400) and must not count as engine failures
+            # decode FIRST (outside the breaker guard): undecodable bytes
+            # are the client's fault (400), never an engine failure — and
+            # never worth a peer round trip (no peer can hold a digest
+            # whose bytes never decoded anywhere; a garbage-bytes flood
+            # must not amplify into fleet GET /mpi traffic)
             image = _decode_image(image_bytes)
-            entry = self._breaker_guard(
-                "predict", self.engine.predict, image, bucket.spec,
-                request_id, weights,
-            )
+            # an OPEN breaker sheds BEFORE any peer network work: the old
+            # fast Retry-After contract — and a replica that cannot render
+            # must not answer 200 predicts it can only 503 renders for.
+            # (Pure admission probe; the half-open trial slot is consumed
+            # at dispatch, exactly as in render().)
+            if self.breaker.rejecting():
+                self.metrics.shed_requests.inc(reason="breaker_open")
+                raise BreakerOpen(
+                    self.breaker.retry_after_s() or self.retry_after_s
+                )
+            # then the fleet wire: a peer holding this exact key hands us
+            # the compressed MPI for network bytes instead of encoder FLOPs
+            entry = self._peer_fetch(key, digest, request_id=request_id)
+            from_peer = entry is not None
+            if entry is None:
+                entry = self._breaker_guard(
+                    "predict", self.engine.predict, image, bucket.spec,
+                    request_id, weights,
+                )
             self.cache.put(key, entry)
             future.set_result(entry)
         except BaseException as exc:
@@ -510,7 +567,133 @@ class ServingApp:
         finally:
             with self._inflight_lock:
                 self._inflight.pop(key, None)
-        return response(entry, cached=False)
+        return response(entry, cached=from_peer)
+
+    def configure_peers(self, peers: dict[str, str] | None,
+                        peer_name: str | None, vnodes: int = 64) -> None:
+        """(Re)declare fleet membership for peer fetch. Callable after
+        construction because a replica's own URL typically exists only once
+        its server has bound a port (tools/bench_fleet.py builds the apps
+        first, then the servers). None/empty disables peer fetch.
+
+        `vnodes` MUST match the router's (FleetApp default 64): the
+        replica-side ring exists to agree with the router about who owns a
+        digest — a mismatched vnode count silently reorders candidates and
+        peer fetch asks the wrong peers (pure waste, never an error)."""
+        if not peers:
+            self.peers, self.peer_name, self._peer_ring = {}, None, None
+            return
+        # validate BEFORE any assignment: a rejected reconfigure must
+        # leave the previous (working) membership fully in effect, never a
+        # new peer map paired with the old ring
+        if not peer_name or peer_name not in peers:
+            raise ValueError(
+                "peer_name must name this replica inside peers "
+                f"(got {peer_name!r}, peers {sorted(peers)})"
+            )
+        from mine_tpu.serving.fleet import HashRing
+
+        ring = HashRing(list(peers), vnodes=vnodes)
+        self.peers, self.peer_name, self._peer_ring = dict(peers), peer_name, ring
+
+    def _peer_fetch(self, key, digest: str, request_id: str | None = None):
+        """Try to adopt this key's compressed MPI from a MORE authoritative
+        peer (every replica earlier than us in the consistent-hash
+        candidate order for this digest — when we ARE the owner the list is
+        empty and no network is touched; after a membership change the
+        previous owner is exactly the replica before us). Returns the
+        device-adopted entry or None; NEVER raises — every failure outcome
+        is a counter tick and a fallthrough to the local predict."""
+        # ONE consistent membership snapshot: configure_peers may swap
+        # ring/peers/name under a live server (bench_fleet does), and a
+        # name resolved against the old ring must not KeyError against the
+        # new peer map — that would 500 a predict the never-raises
+        # contract promises to serve locally
+        ring, peers, self_name = self._peer_ring, self.peers, self.peer_name
+        if ring is None:
+            return None
+        candidates = ring.candidates(digest)
+        try:
+            upstream = candidates[:candidates.index(self_name)]
+        except ValueError:  # we are not on the ring (config drift): ask the owner
+            upstream = candidates[:1]
+        if not upstream:
+            return None
+        # one transport, one error taxonomy: the router's (fleet.py
+        # _urllib_transport) — statuses are answers, TimeoutError is a
+        # blown budget, ConnectionError is an unreachable/mid-response-dead
+        # peer. A second hand-rolled urllib client here would fork the
+        # classification the fleet already hardened.
+        from mine_tpu.serving.fleet import _urllib_transport
+
+        key_str = key_to_str(key)
+        # ONE deadline for the whole fetch (the documented contract of
+        # serving.peer_fetch_timeout_s): up to two upstream peers — the
+        # owner plus one failover — SHARE the budget, so a blackholed
+        # owner cannot stack a second full timeout on top of its own
+        deadline = time.monotonic() + self.peer_fetch_timeout_s
+        for name in upstream[:2]:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            base_url = peers.get(name)
+            if base_url is None:  # membership changed mid-flight
+                continue
+            url = f"{base_url.rstrip('/')}/mpi/{key_str}"
+            outcome = "error"
+            try:
+                with self.tracer.span("peer_fetch", cat="serve", peer=name,
+                                      request_id=request_id):
+                    status, _, body = _urllib_transport(
+                        "GET", url, None, {}, remaining
+                    )
+                if status == 200:
+                    entry = from_wire(body)
+                    if tuple(entry.bucket) != tuple(key[2:5]):
+                        raise ValueError(
+                            f"peer {name} returned bucket {entry.bucket} "
+                            f"for key bucket {key[2:5]}"
+                        )
+                    # config drift between peers is NOT all key-fenced:
+                    # the tier is, but prune_eps and the full plane count
+                    # (mpi.num_bins_fine rides the bucket's S_coarse key
+                    # unchanged) are not. A pruned entry would break this
+                    # replica's no-prune contract; a wrong-plane-count
+                    # entry would 500 every render with an XLA shape
+                    # error until evicted. Surface the drift as its own
+                    # outcome and pay the local predict.
+                    full = self.engine.bucket(key[2:5]).num_planes
+                    if isinstance(entry, CompressedMPI):
+                        drifted = (
+                            entry.tier != key[5]
+                            or entry.num_planes_full != full
+                            or (not self.engine.prune_eps
+                                and entry.planes_kept
+                                < entry.num_planes_full)
+                        )
+                    else:
+                        drifted = int(np.shape(entry.mpi_rgb)[1]) != full
+                    if drifted:
+                        self.metrics.peer_fetch.inc(outcome="incompatible")
+                        return None
+                    entry = self.engine._adopt_entry(entry)
+                    self.metrics.peer_fetch.inc(outcome="hit")
+                    return entry
+                outcome = "miss" if status == 404 else "error"
+            except TimeoutError:
+                outcome = "timeout"
+            except Exception:  # noqa: BLE001 - degrade to local predict
+                outcome = "error"
+            self.metrics.peer_fetch.inc(outcome=outcome)
+        return None
+
+    def compressed_blob(self, key_str: str) -> bytes | None:
+        """The cached entry for `key_str` as wire bytes (the GET /mpi/<key>
+        body), or None when not resident. record=False: a peer's probe is
+        not this replica's client traffic — hit/miss rates stay about the
+        images THIS replica was asked to serve."""
+        entry = self.cache.get(key_from_str(key_str), record=False)
+        return None if entry is None else to_wire(entry)
 
     def render(
         self,
@@ -724,6 +907,22 @@ class _Handler(BaseHTTPRequestHandler):
             return self._predict(app), "predict"
         if method == "POST" and path == "/render":
             return self._render(app), "render"
+        if method == "GET" and path.startswith("/mpi/"):
+            # the fleet wire: the compressed container for one cache key,
+            # served to peer replicas (serving/compress.py to_wire)
+            key_str = path[len("/mpi/"):]
+            try:
+                blob = app.compressed_blob(key_str)
+            except ValueError as exc:
+                self._send_json(400, {"error": f"bad mpi key: {exc}"})
+                return 400, "mpi"
+            if blob is None:
+                self._send_json(404, {
+                    "error": f"mpi_key {key_str} not cached here",
+                })
+                return 404, "mpi"
+            self._send(200, blob, "application/octet-stream")
+            return 200, "mpi"
         if method == "GET" and path == "/admin/swap":
             self._send_json(200, app.swap_status())
             return 200, "admin_swap"
@@ -977,6 +1176,18 @@ def main(argv: list[str] | None = None) -> None:
         "empty trace; the trace-counter metric family stays at 0)",
     )
     parser.add_argument(
+        "--peer", action="append", default=[], metavar="NAME=URL",
+        help="fleet peer replica (repeatable; include THIS replica too and "
+        "name it with --peer-name). On a local cache miss the server asks "
+        "the digest's ring owner for the compressed MPI (GET /mpi/<key>) "
+        "before re-running the encoder — cache capacity becomes "
+        "fleet-wide.",
+    )
+    parser.add_argument(
+        "--peer-name", default=None,
+        help="this replica's name inside the --peer set",
+    )
+    parser.add_argument(
         "--watch-last-good", type=float, default=0.0, metavar="SECS",
         help="poll the workspace's last_good pointer every SECS seconds "
         "and hot-swap to newer vetted checkpoints (0 disables; "
@@ -989,6 +1200,18 @@ def main(argv: list[str] | None = None) -> None:
     )
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
+
+    peers = {}
+    for spec in args.peer:
+        name, _, url = spec.partition("=")
+        if not name or not url:
+            parser.error(f"--peer must be NAME=URL, got {spec!r}")
+        peers[name] = url
+    if peers and (not args.peer_name or args.peer_name not in peers):
+        parser.error(
+            f"--peer-name must name this replica inside the --peer set "
+            f"(got {args.peer_name!r}, peers {sorted(peers)})"
+        )
 
     from mine_tpu.utils.platform import honor_jax_platforms
 
@@ -1011,6 +1234,7 @@ def main(argv: list[str] | None = None) -> None:
         trace_enabled=not args.no_trace,
         peak_flops_override=args.peak_flops,
         swap_source=args.workspace,
+        peers=peers or None, peer_name=args.peer_name,
     )
     if args.watch_last_good > 0:
         # a training job advancing the workspace's last_good pointer

@@ -1,0 +1,722 @@
+"""Compressed MPI tier tests (serving/compress.py end to end): quantization
+round-trip bounds, transmittance pruning, the wire format, mixed-tier cache
+byte accounting, the FakeEngine compile-free tier path, fleet peer fetch,
+the perf-ledger economics streams — and the convergence-harness-gated PSNR
+parity per tier on the eval scene (the acceptance tolerances: bf16 within
+0.05 dB, int8 within 0.2 dB, pruning at the default eps within 0.1 dB of
+fp32). Everything except the parity test is numpy/fake-engine work; the
+parity test compiles ONE small render executable (64x64, S=8 — no model)."""
+
+from __future__ import annotations
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from mine_tpu.serving import compress as C
+from mine_tpu.serving.cache import MPICache, MPIEntry, key_from_str, mpi_key
+from mine_tpu.serving.metrics import ServingMetrics
+
+
+def _slabs(s=8, h=16, w=16, seed=0):
+    rng = np.random.default_rng(seed)
+    rgb = rng.uniform(0.0, 1.0, (1, s, h, w, 3)).astype(np.float32)
+    sigma = rng.uniform(0.0, 5.0, (1, s, h, w, 1)).astype(np.float32)
+    disp = np.linspace(1.0, 0.05, s, dtype=np.float32)[None]
+    k = np.eye(3, dtype=np.float32)[None]
+    return rgb, sigma, disp, k
+
+
+# ------------------------------------------------------------- quantization
+
+
+def test_fp32_no_prune_is_a_plain_entry_noop():
+    rgb, sigma, disp, k = _slabs()
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8))
+    assert isinstance(e, MPIEntry)
+    # the SAME arrays, not copies: bitwise the predict executable's output
+    assert e.mpi_rgb is rgb and e.mpi_sigma is sigma
+
+
+def test_quantize_roundtrip_error_bounds_and_bytes():
+    rgb, sigma, disp, k = _slabs()
+    fp32 = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8))
+    bf16 = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                          tier="bf16")
+    int8 = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                          tier="int8")
+    # byte economics: the whole point. bf16 halves the slabs; int8 quarters
+    # them (plus small per-plane scale sidecars)
+    assert bf16.nbytes < 0.55 * fp32.nbytes
+    assert int8.nbytes < 0.30 * fp32.nbytes
+    # dequant error bounds: bf16 has 8 mantissa bits (~2^-8 relative);
+    # int8's affine step is range/255, error <= step/2 + fp rounding
+    r, s, d, kk = C.decompress(bf16)
+    assert np.abs(np.asarray(r) - rgb).max() <= np.abs(rgb).max() * 2 ** -8
+    np.testing.assert_array_equal(np.asarray(d), disp)  # fp32 sidecars exact
+    r8, s8, _, _ = C.decompress(int8)
+    for got, want in ((np.asarray(r8), rgb), (np.asarray(s8), sigma)):
+        step = (want.max(axis=(2, 3, 4), keepdims=True)
+                - want.min(axis=(2, 3, 4), keepdims=True)) / 255.0
+        assert np.all(np.abs(got - want) <= step * 0.51 + 1e-6)
+
+
+def test_int8_constant_plane_roundtrips_exactly():
+    rgb, sigma, disp, k = _slabs()
+    rgb[:, 2] = 0.25  # a constant plane: scale floors at ~0, lo carries it
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8), tier="int8")
+    r, _, _, _ = C.decompress(e)
+    np.testing.assert_allclose(np.asarray(r)[:, 2], 0.25, atol=1e-6)
+
+
+# ------------------------------------------------------------------ pruning
+
+
+def test_prune_drops_transparent_planes_and_carries_disparity():
+    rgb, sigma, disp, k = _slabs()
+    sigma[:, 5:] = 1e-7  # far planes effectively empty
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                       tier="fp32", prune_eps=1e-3)
+    assert isinstance(e, C.CompressedMPI)  # pruning alone compresses
+    # planes 0-4 contribute; the ORIGINAL last plane (7) is always kept in
+    # sigma mode — its background-distance slot is a constant the gap
+    # compensation could not hand to a promoted survivor
+    kept_idx = [0, 1, 2, 3, 4, 7]
+    assert e.planes_kept == 6 and e.num_planes_full == 8
+    # the SURVIVING disparities travel with the slabs, original order
+    np.testing.assert_array_equal(np.asarray(e.disparity),
+                                  disp[:, kept_idx])
+    np.testing.assert_array_equal(np.asarray(e.rgb), rgb[:, kept_idx])
+    assert e.nbytes < 0.8 * C.compress_mpi(
+        rgb, sigma, disp, k, bucket=(16, 16, 8)).nbytes
+
+
+def test_prune_keeps_occluded_and_empty_planes_out_but_never_all():
+    rgb, sigma, disp, k = _slabs()
+    # a fully opaque first plane occludes everything behind it: the
+    # accumulated transmittance kills every later plane's contribution —
+    # only the occluder and the always-kept original last plane survive
+    sigma[:] = 0.0
+    sigma[:, 0] = 1e4
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                       tier="fp32", prune_eps=1e-3)
+    assert e.planes_kept == 2
+    np.testing.assert_array_equal(np.asarray(e.disparity),
+                                  disp[:, [0, 7]])
+    # an all-transparent MPI still keeps a best plane (never an empty set)
+    sigma[:] = 1e-9
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                       tier="fp32", prune_eps=1e-3)
+    assert e.planes_kept <= 2
+
+
+def test_prune_promoted_last_slot_never_happens_off_axis_exact():
+    """The regression the forced last-plane keep exists for: with wide-FOV
+    intrinsics (ray norm >> 1 off-axis), pruning everything behind a
+    semi-transparent plane must still reproduce the original source-pose
+    composite at CORNER pixels — a survivor promoted into the constant
+    background slot would be wrong exactly there."""
+    from mine_tpu import ops
+
+    s, h, w = 4, 16, 16
+    rng = np.random.default_rng(2)
+    rgb = rng.uniform(0.0, 1.0, (1, s, h, w, 3)).astype(np.float32)
+    sigma = np.full((1, s, h, w, 1), 1e-7, np.float32)
+    sigma[:, 0] = 0.6
+    sigma[:, 1] = 0.9
+    disp = np.linspace(1.0, 0.2, s, dtype=np.float32)[None]
+    # short focal length: corner ray norms are far from 1
+    k = np.asarray([[[8.0, 0, 8.0], [0, 8.0, 8.0], [0, 0, 1.0]]],
+                   np.float32)
+    k_inv = np.asarray(ops.inverse_3x3(k))
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(h, w, s),
+                       tier="fp32", prune_eps=1e-3)
+    assert e.planes_kept == 3  # planes 0, 1 + the forced original last
+    full_rgb, _, _, _ = ops.render_src(rgb, sigma, disp, k_inv)
+    pr, ps, pd, _ = C.decompress(e)
+    pruned_rgb, _, _, _ = ops.render_src(
+        np.asarray(pr), np.asarray(ps), np.asarray(pd), k_inv)
+    np.testing.assert_allclose(np.asarray(pruned_rgb),
+                               np.asarray(full_rgb), atol=2e-3)
+
+
+def test_plane_contributions_matches_compositor_weights():
+    """The pruning signal IS the dense compositor's per-plane weight: with
+    the parallax dilation off, the (S,) contributions equal max over
+    pixels of render_src's weights tensor (the quantity every plane's rgb
+    is actually multiplied by)."""
+    from mine_tpu import ops
+
+    rgb, sigma, disp, k = _slabs(s=6)
+    k_inv = np.asarray(ops.inverse_3x3(k))
+    contrib = np.asarray(ops.plane_contributions(sigma, disp, k_inv,
+                                                 vis_dilate_px=0))
+    _, _, _, weights = ops.render_src(rgb, sigma, disp, k_inv)
+    np.testing.assert_allclose(
+        contrib, np.asarray(weights).max(axis=(0, 2, 3, 4)), rtol=1e-6
+    )
+
+
+def test_plane_contributions_dilation_protects_disocclusions():
+    """A plane fully occluded at the source pose but exposed right next to
+    the occluder's edge (the disocclusion case a novel pose reveals) must
+    survive the dilated visibility — while a plane buried EVERYWHERE far
+    deeper than the parallax radius still reads as droppable."""
+    from mine_tpu import ops
+
+    s, h, w = 3, 32, 32
+    sigma = np.zeros((1, s, h, w, 1), np.float32)
+    # plane 0: an opaque occluder covering the LEFT half only
+    sigma[:, 0, :, : w // 2] = 1e4
+    # plane 1: opaque content strictly UNDER the occluder (source T ~ 0
+    # there, but its edge is within the dilation radius)
+    sigma[:, 1, :, : w // 2] = 1e4
+    # plane 2: nothing anywhere
+    disp = np.linspace(1.0, 0.2, s, dtype=np.float32)[None]
+    k_inv = np.eye(3, dtype=np.float32)[None]
+    occluded = np.asarray(ops.plane_contributions(
+        sigma, disp, k_inv, vis_dilate_px=0))
+    revealed = np.asarray(ops.plane_contributions(
+        sigma, disp, k_inv, vis_dilate_px=8))
+    assert occluded[1] < 1e-3  # source-pose-only view would prune it
+    assert revealed[1] > 0.5   # the dilation keeps it for disocclusion
+    assert revealed[2] < 1e-5  # truly empty planes still prune
+
+
+def test_prune_gap_compensation_preserves_surviving_transparency():
+    """Sigma-mode pruning must NOT brighten survivors: dropping a run of
+    near-empty planes widens the preceding kept plane's inter-plane gap,
+    and alpha = 1 - exp(-sigma*dist) would inflate with it — the sigma
+    rescale (_prune_sigma_scale) preserves each survivor's transparency,
+    so the source-pose composite of the pruned MPI matches the original."""
+    from mine_tpu import ops
+
+    s, h, w = 8, 16, 16
+    rng = np.random.default_rng(1)
+    rgb = rng.uniform(0.0, 1.0, (1, s, h, w, 3)).astype(np.float32)
+    sigma = np.full((1, s, h, w, 1), 1e-7, np.float32)
+    # a SEMI-TRANSPARENT near plane (the victim: its original gap is one
+    # plane, after pruning it faces the far content plane directly) and an
+    # opaque far plane; planes 1..6 are empty
+    sigma[:, 0] = 0.8
+    sigma[:, 7] = 50.0
+    disp = np.linspace(1.0, 0.2, s, dtype=np.float32)[None]
+    k = np.asarray([[[64.0, 0, 8.0], [0, 64.0, 8.0], [0, 0, 1.0]]],
+                   np.float32)
+    k_inv = np.asarray(ops.inverse_3x3(k))
+
+    e = C.compress_mpi(rgb, sigma, disp, k, bucket=(h, w, s),
+                       tier="fp32", prune_eps=1e-3)
+    assert isinstance(e, C.CompressedMPI) and e.planes_kept == 2
+
+    full_rgb, _, _, full_w = ops.render_src(rgb, sigma, disp, k_inv)
+    pr, ps, pd, _ = C.decompress(e)
+    pruned_rgb, _, _, pruned_w = ops.render_src(
+        np.asarray(pr), np.asarray(ps), np.asarray(pd), k_inv)
+    # the survivor's compositing weight is preserved, not inflated
+    np.testing.assert_allclose(
+        np.asarray(pruned_w)[:, 0], np.asarray(full_w)[:, 0],
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(np.asarray(pruned_rgb), np.asarray(full_rgb),
+                               rtol=1e-3, atol=1e-3)
+    # and the correction genuinely did something: the naive slice (no
+    # rescale) WOULD have brightened the near plane's contribution
+    naive_rgb, _, _, naive_w = ops.render_src(
+        rgb[:, [0, 7]], sigma[:, [0, 7]], disp[:, [0, 7]], k_inv)
+    assert (np.asarray(naive_w)[:, 0].max()
+            > 1.5 * np.asarray(full_w)[:, 0].max())
+
+
+# -------------------------------------------------------------- wire format
+
+
+def test_wire_roundtrip_all_tiers_bitwise():
+    rgb, sigma, disp, k = _slabs()
+    for tier, eps in (("fp32", 0.0), ("fp32", 1e-3), ("bf16", 0.0),
+                      ("int8", 0.0), ("int8", 1e-3)):
+        e = C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                           tier=tier, prune_eps=eps)
+        back = C.from_wire(C.to_wire(e))
+        assert type(back) is type(e)
+        if isinstance(e, MPIEntry):
+            np.testing.assert_array_equal(np.asarray(back.mpi_rgb),
+                                          np.asarray(e.mpi_rgb))
+            assert back.nbytes == e.nbytes
+        else:
+            assert back.tier == e.tier
+            assert back.bucket == e.bucket
+            assert back.planes_kept == e.planes_kept
+            assert back.num_planes_full == e.num_planes_full
+            assert back.nbytes == e.nbytes
+            for name, a in e._arrays().items():
+                b = back._arrays()[name]
+                if a is None:
+                    assert b is None
+                else:
+                    np.testing.assert_array_equal(
+                        np.asarray(b).view(np.uint8),
+                        np.asarray(a).view(np.uint8),
+                    )
+
+
+def test_wire_rejects_garbage_and_truncation():
+    rgb, sigma, disp, k = _slabs()
+    blob = C.to_wire(C.compress_mpi(rgb, sigma, disp, k, bucket=(16, 16, 8),
+                                    tier="int8"))
+    with pytest.raises(ValueError):
+        C.from_wire(b"not a wire blob")
+    with pytest.raises(ValueError):
+        C.from_wire(blob[: len(blob) // 2])  # truncated mid-buffer
+    with pytest.raises(ValueError):
+        C.from_wire(blob[:10])  # truncated header
+    # an int8 blob whose header omits the quantization sidecars would
+    # dequantize into None.astype at render time — refused at parse
+    import json as _json
+
+    head_len = int.from_bytes(blob[6:14], "little")
+    header = _json.loads(blob[14:14 + head_len])
+    kept_fields = {n: s for n, s in header["fields"].items()
+                   if not n.endswith(("_lo", "_scale"))}
+    sizes = {n: int(np.prod(s["shape"])) * np.dtype(
+        C._bf16_dtype() if s["dtype"] == "bfloat16" else s["dtype"]
+    ).itemsize for n, s in header["fields"].items()}
+    body = blob[14 + head_len:]
+    new_body, off = b"", 0
+    for n, s in header["fields"].items():
+        if n in kept_fields:
+            new_body += body[off:off + sizes[n]]
+        off += sizes[n]
+    header["fields"] = kept_fields
+    new_head = _json.dumps(header).encode()
+    bad = (blob[:6] + len(new_head).to_bytes(8, "little") + new_head
+           + new_body)
+    with pytest.raises(ValueError, match="missing fields"):
+        C.from_wire(bad)
+
+
+# ----------------------------------------------- mixed-tier cache accounting
+
+
+def test_cache_mixed_tier_byte_accounting_and_eviction():
+    """The MPICache satellite: capacity counted in COMPRESSED bytes,
+    eviction in LRU order across mixed-tier entries, and tier-qualified
+    keys of one image never colliding."""
+    rgb, sigma, disp, k = _slabs()
+    mk = lambda tier, eps=0.0: C.compress_mpi(  # noqa: E731
+        rgb, sigma, disp, k, bucket=(16, 16, 8), tier=tier, prune_eps=eps)
+    fp32, bf16, int8 = mk("fp32"), mk("bf16"), mk("int8")
+    assert fp32.nbytes > bf16.nbytes > int8.nbytes
+
+    m = ServingMetrics()
+    cache = MPICache(byte_budget=fp32.nbytes + bf16.nbytes + int8.nbytes,
+                     metrics=m)
+    k_fp = mpi_key("img", 7, (16, 16, 8), "fp32")
+    k_bf = mpi_key("img", 7, (16, 16, 8), "bf16")
+    k_i8 = mpi_key("img", 7, (16, 16, 8), "int8")
+    assert len({k_fp, k_bf, k_i8}) == 3  # one image, three distinct keys
+    cache.put(k_fp, fp32)
+    cache.put(k_bf, bf16)
+    cache.put(k_i8, int8)
+    # resident bytes are the sum of COMPRESSED sizes, exactly
+    assert cache.bytes_resident == fp32.nbytes + bf16.nbytes + int8.nbytes
+    assert m.cache_bytes_resident.value() == cache.bytes_resident
+
+    # touch fp32 so bf16 is the LRU victim; one more int8-sized entry fits
+    # only after evicting it (the budget math is in compressed bytes)
+    assert cache.get(k_fp) is not None
+    evicted = cache.put(mpi_key("img2", 7, (16, 16, 8), "int8"),
+                        mk("int8"))
+    assert evicted == [k_bf]
+    assert cache.get(k_bf) is None
+    assert cache.bytes_resident == fp32.nbytes + 2 * int8.nbytes
+
+
+# ------------------------------------- FakeEngine tier path (compile-free)
+
+
+def _png(i: int = 0) -> bytes:
+    from PIL import Image
+
+    img = np.full((8, 8, 3), (i * 53) % 256, np.uint8)
+    img[0, 0] = (i % 256, 3, 9)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _tier_cfg(tier: str, eps: float, planes: int = 8):
+    from mine_tpu.config import Config
+
+    return Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128,
+        "mpi.num_bins_coarse": planes,
+        "serving.cache_tier": tier,
+        "serving.prune_transmittance_eps": eps,
+    })
+
+
+def test_fake_engine_int8_prune_ratio_and_render():
+    """The fake slabs are digest-seeded with a realistic transmittance
+    falloff, so the full tier path runs compile-free and MEANS something:
+    int8 + pruning must beat fp32 capacity-per-byte by >= 3x (the bench
+    acceptance bar), prune some planes, and still render the generation
+    marker within quantization tolerance."""
+    from mine_tpu.serving.fake import make_fake_app
+
+    app_fp = make_fake_app(cfg=_tier_cfg("fp32", 0.0), checkpoint_step=1)
+    app_i8 = make_fake_app(
+        cfg=_tier_cfg("int8", C.DEFAULT_PRUNE_EPS), checkpoint_step=1)
+    try:
+        png = _png(3)
+        r_fp = app_fp.predict(png)
+        r_i8 = app_i8.predict(png)
+        assert r_fp["tier"] == "fp32" and r_i8["tier"] == "int8"
+        assert r_i8["planes_kept"] < r_i8["planes"]
+        assert r_fp["mpi_bytes"] >= 3 * r_i8["mpi_bytes"], (r_fp, r_i8)
+        # distinct images -> distinct (non-constant) slabs
+        entry_a = app_i8.cache.get(key_from_str(r_i8["mpi_key"]))
+        r_b = app_i8.predict(_png(4))
+        entry_b = app_i8.cache.get(key_from_str(r_b["mpi_key"]))
+        assert not np.array_equal(np.asarray(entry_a.rgb),
+                                  np.asarray(entry_b.rgb))
+        assert np.asarray(entry_a.sigma).std() > 0  # non-constant falloff
+        # pruned-entry render still carries the generation marker (step 1
+        # -> fill 1.0) within int8 tolerance
+        rgb, _ = app_i8.render(r_i8["mpi_key"],
+                               np.eye(4, dtype=np.float32)[None])
+        assert abs(float(rgb[0, 0, 0, 0]) - 1.0) < 0.05
+        # the pruning observability counter ticked
+        assert app_i8.metrics.pruned_planes.value() > 0
+    finally:
+        app_fp.close()
+        app_i8.close()
+
+
+def test_fake_engine_fp32_marker_is_exact():
+    """The default tier stays a numerics no-op: the generation marker reads
+    back EXACTLY (the swap/generation tests elsewhere depend on it)."""
+    from mine_tpu.serving.fake import FakeEngine
+
+    engine = FakeEngine(checkpoint_step=1)
+    entry = engine.predict(np.zeros((8, 8, 3), np.uint8))
+    assert float(np.asarray(entry.mpi_rgb).flat[0]) == 1.0
+
+
+# --------------------------------------------------------- fleet peer fetch
+
+
+def _owned_png(owner: str, members: list[str]) -> bytes:
+    import hashlib
+
+    from mine_tpu.serving.fleet import HashRing
+
+    ring = HashRing(members)
+    for i in range(200):
+        png = _png(i)
+        if ring.candidates(hashlib.sha256(png).hexdigest())[0] == owner:
+            return png
+    raise AssertionError(f"no digest owned by {owner} in 200 tries")
+
+
+def test_peer_fetch_serves_from_owner_cache_without_encoder():
+    """The wire's point: replica B, asked for an image whose ring owner A
+    already cached it, adopts A's compressed container over GET /mpi/<key>
+    — zero encoder invocations on B, outcome=hit counted, and the entry
+    renders on B."""
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.server import make_server
+
+    cfg = _tier_cfg("int8", C.DEFAULT_PRUNE_EPS)
+    apps = [make_fake_app(cfg=cfg) for _ in range(2)]
+    servers = [make_server(a) for a in apps]
+    try:
+        urls = {}
+        for i, srv in enumerate(servers):
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            host, port = srv.server_address[:2]
+            urls[f"r{i}"] = f"http://{host}:{port}"
+        for i, a in enumerate(apps):
+            a.configure_peers(urls, f"r{i}")
+        png = _owned_png("r0", ["r0", "r1"])
+        owner_resp = apps[0].predict(png)  # the owner pays the encoder pass
+        peer_resp = apps[1].predict(png)
+        assert peer_resp["mpi_key"] == owner_resp["mpi_key"]
+        assert peer_resp["cached"] is True
+        assert apps[1].metrics.encoder_invocations.value() == 0
+        assert apps[1].metrics.peer_fetch.value(outcome="hit") == 1
+        # the adopted compressed entry is fully renderable on the fetcher
+        rgb, _ = apps[1].render(peer_resp["mpi_key"],
+                                np.eye(4, dtype=np.float32)[None])
+        assert rgb.shape == (1, 128, 128, 3)
+        # repeat: now a plain local hit, no second fetch
+        again = apps[1].predict(png)
+        assert again["cached"] is True
+        assert apps[1].metrics.peer_fetch.value(outcome="hit") == 1
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        for a in apps:
+            a.close()
+
+
+def test_peer_fetch_degrades_to_local_predict_on_dead_peer():
+    """A dead/unreachable owner must cost one bounded attempt and a counter
+    tick, then the replica pays its own encoder pass — never an error."""
+    from mine_tpu.serving.fake import make_fake_app
+
+    app = make_fake_app(cfg=_tier_cfg("fp32", 0.0))
+    try:
+        # r0 (the would-be owner for some digest) points at a dead port
+        app.configure_peers(
+            {"r0": "http://127.0.0.1:9", "r1": "http://127.0.0.1:9"}, "r1"
+        )
+        png = _owned_png("r0", ["r0", "r1"])
+        resp = app.predict(png)
+        assert resp["cached"] is False  # locally predicted
+        assert app.metrics.encoder_invocations.value() == 1
+        outcomes = (app.metrics.peer_fetch.value(outcome="error")
+                    + app.metrics.peer_fetch.value(outcome="timeout"))
+        assert outcomes >= 1
+        assert app.metrics.peer_fetch.value(outcome="hit") == 0
+    finally:
+        app.close()
+
+
+def test_peer_fetch_rejects_mismatched_pruning_operating_point():
+    """prune_eps is NOT part of the key (only tier is), so a mid-rollout
+    fleet can offer a pruned entry under an fp32 key to a replica whose
+    contract is no-prune fp32 — the fetcher must refuse it (outcome
+    `incompatible`, surfacing the config drift) and pay a local predict
+    rather than silently adopt a representation it never warms executables
+    for."""
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.server import make_server
+
+    # owner B prunes under the fp32 tier; fetcher A runs the no-op default
+    app_b = make_fake_app(cfg=_tier_cfg("fp32", C.DEFAULT_PRUNE_EPS))
+    app_a = make_fake_app(cfg=_tier_cfg("fp32", 0.0))
+    servers = [make_server(a) for a in (app_a, app_b)]
+    try:
+        urls = {}
+        for name, srv in zip(("r0", "r1"), servers):
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            host, port = srv.server_address[:2]
+            urls[name] = f"http://{host}:{port}"
+        # r1 is app_b: pick an image OWNED by r1 so r0 (app_a) fetches
+        png = _owned_png("r1", ["r0", "r1"])
+        app_a.configure_peers(urls, "r0")
+        owner_resp = app_b.predict(png)
+        assert owner_resp["planes_kept"] < owner_resp["planes"]  # pruned
+        resp = app_a.predict(png)
+        assert resp["cached"] is False  # refused the peer entry
+        assert resp["planes_kept"] == resp["planes"]  # local no-prune entry
+        assert app_a.metrics.peer_fetch.value(outcome="incompatible") == 1
+        assert app_a.metrics.peer_fetch.value(outcome="hit") == 0
+        assert app_a.metrics.encoder_invocations.value() == 1
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        app_a.close()
+        app_b.close()
+
+
+def test_peer_fetch_rejects_mismatched_plane_count():
+    """mpi.num_bins_fine rides the same S_coarse key component, so a c2f
+    peer's (S_coarse + fine)-plane entry carries the SAME mpi_key as a
+    non-c2f replica's — adopting it would XLA-shape-error every render.
+    The adoption fence must refuse it like the prune-eps drift."""
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.server import make_server
+
+    cfg_a = _tier_cfg("fp32", 0.0)
+    cfg_b = cfg_a.replace(**{"mpi.num_bins_fine": 2})  # 10-plane entries
+    app_b = make_fake_app(cfg=cfg_b)
+    app_a = make_fake_app(cfg=cfg_a)
+    servers = [make_server(a) for a in (app_a, app_b)]
+    try:
+        urls = {}
+        for name, srv in zip(("r0", "r1"), servers):
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            host, port = srv.server_address[:2]
+            urls[name] = f"http://{host}:{port}"
+        png = _owned_png("r1", ["r0", "r1"])
+        app_a.configure_peers(urls, "r0")
+        owner = app_b.predict(png)
+        assert owner["planes"] == 10  # coarse 8 + fine 2
+        resp = app_a.predict(png)
+        assert resp["mpi_key"] == owner["mpi_key"]  # the aliasing is real
+        assert resp["cached"] is False  # ...and the fence refused it
+        assert resp["planes"] == 8
+        assert app_a.metrics.peer_fetch.value(outcome="incompatible") == 1
+        # the locally predicted entry renders fine
+        rgb, _ = app_a.render(resp["mpi_key"],
+                              np.eye(4, dtype=np.float32)[None])
+        assert rgb.shape == (1, 128, 128, 3)
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        app_a.close()
+        app_b.close()
+
+
+def test_configure_peers_rejects_bad_name_without_half_update():
+    """A rejected live reconfigure must leave the previous membership
+    fully in effect — not a new peer map paired with the old ring."""
+    from mine_tpu.serving.fake import make_fake_app
+
+    app = make_fake_app(cfg=_tier_cfg("fp32", 0.0))
+    try:
+        good = {"r0": "http://127.0.0.1:9", "r1": "http://127.0.0.1:10"}
+        app.configure_peers(good, "r0")
+        with pytest.raises(ValueError):
+            app.configure_peers({"x0": "http://127.0.0.1:11"}, "typo")
+        assert app.peers == good and app.peer_name == "r0"
+        assert app._peer_ring is not None
+        assert sorted(app._peer_ring.members) == ["r0", "r1"]
+    finally:
+        app.close()
+
+
+def test_peer_fetch_owner_has_no_upstream():
+    """When this replica IS the digest's owner, no peer is more
+    authoritative: no network is touched at all."""
+    from mine_tpu.serving.fake import make_fake_app
+
+    app = make_fake_app(cfg=_tier_cfg("fp32", 0.0))
+    try:
+        # dead URLs everywhere: if the owner path touched the network the
+        # outcome counters would tick
+        app.configure_peers(
+            {"r0": "http://127.0.0.1:9", "r1": "http://127.0.0.1:9"}, "r0"
+        )
+        png = _owned_png("r0", ["r0", "r1"])
+        assert app.predict(png)["cached"] is False
+        for outcome in ("hit", "miss", "error", "timeout"):
+            assert app.metrics.peer_fetch.value(outcome=outcome) == 0
+    finally:
+        app.close()
+
+
+# ----------------------------------------- perf-ledger economics streams
+
+
+def test_ledger_check_gates_cache_economics_streams():
+    """The new capacity-per-byte and hit-rate fields ride the same
+    min-history rolling-baseline rules as every stream: a capacity or
+    hit-rate drop beyond threshold fails the check (and therefore the
+    chaos drill's final verdict, which runs the same check)."""
+    from mine_tpu.obs import ledger
+
+    def row(entries_per_gib, hit_rate):
+        return ledger.make_row(
+            "fleet_cache_economics", entries_per_gib,
+            {"tier": "int8", "images": 12}, unit="entries/GiB",
+            higher_is_better=True, cache_hit_rate=hit_rate,
+            cache_entries_per_gib=entries_per_gib,
+            device="cpu", backend="cpu",
+        )
+    healthy = [row(4000.0, 0.9), row(4100.0, 0.91), row(4050.0, 0.9)]
+    verdict = ledger.check_rows(healthy, threshold=0.10)
+    assert verdict["ok"] and len(verdict["checked"]) == 1
+    checked_fields = {f["field"] for f in verdict["checked"][0]["fields"]}
+    assert {"value", "cache_hit_rate", "cache_entries_per_gib"} <= checked_fields
+
+    # a hit-rate collapse (tier regression under the same budget) fails
+    # even when the headline value holds
+    verdict = ledger.check_rows(healthy[:2] + [row(4000.0, 0.5)],
+                                threshold=0.10)
+    assert not verdict["ok"]
+    # under min-history the stream is skipped, never failed
+    verdict = ledger.check_rows([row(4000.0, 0.9), row(100.0, 0.1)],
+                                threshold=0.10, min_history=2)
+    assert verdict["ok"] and verdict["skipped"]
+
+
+# -------------------------- PSNR parity per tier (convergence harness gate)
+
+
+def test_tier_psnr_parity_on_eval_scene():
+    """The acceptance tolerances, gated through the convergence harness's
+    own scorer (tools/convergence_run.py psnr/NOVEL_OFFSETS/CROP — the same
+    eval scene and crop every quality number in BASELINE.md uses): against
+    analytic ground truth on novel poses, bf16 scores within 0.05 dB of
+    fp32, int8 within 0.2 dB, and pruning at DEFAULT_PRUNE_EPS within
+    0.1 dB. The MPI is the soft ORACLE construction (no training, no
+    model): per-pixel true disparity assigns src color to bracketing
+    planes, which gives real occlusion/transmittance structure to compress.
+    One render executable (64x64, S=8, no network) serves every tier —
+    pruned entries pad back to the same plane bucket, which also pins the
+    pad-planes-are-inert numerics."""
+    import jax.numpy as jnp
+
+    from tools.convergence_run import CROP, NOVEL_OFFSETS, build_cfg, psnr
+    from tools.oracle_mpi_ceiling import oracle_alphas
+
+    from mine_tpu.data.synthetic import _intrinsics, _render_view
+    from mine_tpu.inference.trajectory import poses_from_offsets
+    from mine_tpu.inference.video import render_many
+
+    h = w = 64
+    s = 8
+    cfg = build_cfg(h, w, batch=1, num_planes=s, disparity_end=0.2)
+    cfg = cfg.replace(**{"mpi.use_alpha": True})
+    k = _intrinsics(h, w)
+    disp_planes = np.linspace(1.0, 0.2, s).astype(np.float32)
+    poses = jnp.asarray(poses_from_offsets(NOVEL_OFFSETS))
+
+    src_img, src_depth = _render_view(h, w, k, np.zeros(3), 2.5)
+    alphas = oracle_alphas(src_depth, disp_planes, "soft")
+    rgb = np.broadcast_to(src_img[None], (s,) + src_img.shape)[None].copy()
+    sigma = alphas[None].astype(np.float32)
+    disp = disp_planes[None]
+    k_b = np.asarray(k, np.float32)[None]
+
+    def score(mpi_rgb, mpi_sigma, mpi_disp) -> float:
+        # pad pruned planes back to the full count exactly like
+        # RenderEngine._render_inputs (zero-sigma planes at the repeated
+        # nearest disparity) so ONE executable serves every tier
+        kept = mpi_rgb.shape[1]
+        if kept < s:
+            pad = s - kept
+            mpi_rgb = np.concatenate(
+                [np.zeros((1, pad, h, w, 3), np.float32), mpi_rgb], axis=1)
+            mpi_sigma = np.concatenate(
+                [np.zeros((1, pad, h, w, 1), np.float32), mpi_sigma], axis=1)
+            mpi_disp = np.concatenate(
+                [np.broadcast_to(mpi_disp[:, :1], (1, pad)), mpi_disp],
+                axis=1)
+        out, _ = render_many(cfg, jnp.asarray(mpi_rgb),
+                             jnp.asarray(mpi_sigma), jnp.asarray(mpi_disp),
+                             jnp.asarray(k_b), poses)
+        out = np.asarray(out)
+        scores = []
+        for i, offset in enumerate(NOVEL_OFFSETS):
+            want, _ = _render_view(h, w, k, -offset, 2.5)
+            scores.append(psnr(out[i, CROP:-CROP, CROP:-CROP],
+                               want[CROP:-CROP, CROP:-CROP]))
+        return float(np.mean(scores))
+
+    def tier_score(tier: str, eps: float) -> tuple[float, int]:
+        e = C.compress_mpi(rgb, sigma, disp, k_b, bucket=(h, w, s),
+                           tier=tier, prune_eps=eps, use_alpha=True)
+        if isinstance(e, MPIEntry):
+            return score(rgb, sigma, disp), s
+        r, sg, d, _ = C.decompress(e)
+        return score(np.asarray(r), np.asarray(sg), np.asarray(d)), \
+            e.planes_kept
+
+    base, _ = tier_score("fp32", 0.0)
+    assert base > 14.0, base  # the oracle scores well above junk
+    bf16, _ = tier_score("bf16", 0.0)
+    int8, _ = tier_score("int8", 0.0)
+    pruned, kept = tier_score("fp32", C.DEFAULT_PRUNE_EPS)
+    assert kept < s  # the eval scene actually HAS prunable planes
+    assert abs(base - bf16) <= 0.05, (base, bf16)
+    assert abs(base - int8) <= 0.20, (base, int8)
+    assert abs(base - pruned) <= 0.10, (base, pruned, kept)

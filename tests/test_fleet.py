@@ -593,6 +593,14 @@ def test_bench_fleet_run_quotes_p95_and_concentration():
     assert result["encoder_invocations_total"] == 4  # affinity, fleet-wide
     assert len(result["per_replica"]) == 2
     assert result["cache_hit_rate"] > 0.5
+    assert result["bytes_per_entry"] > 0
+    # the peer-fetch proof: after the membership change (owner ejected
+    # from the ROUTER, alive as a peer) every relocated image was served
+    # off the peer's cache — fleet-wide encoder invocations UNCHANGED
+    proof = result["peer_fetch_proof"]
+    assert proof["ok"], proof
+    assert proof["encoder_invocations_after"] == 4
+    assert proof["peer_fetch_hits"] > 0
 
 
 # -------------------------------------------- the drill's fleet half (smoke)

@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""Fleet load harness: router p95 + cache-hit concentration over K replicas.
+"""Fleet load harness: router p95, cache-hit concentration, compressed-tier
+cache economics, and the peer-fetch proof over K replicas.
 
 Spawns K in-process replicas + the consistent-hash fleet router
 (mine_tpu/serving/fleet.py) and replays a synthetic trace of M distinct
@@ -14,18 +15,33 @@ PNG encode. Reports:
     measurable: with consistent-hash routing every image's encoder pass
     runs on exactly ONE replica, so fleet-wide encoder_invocations == M
     (without affinity it would approach K*M) and the per-replica hit
-    tables show the arcs.
+    tables show the arcs,
+  * compressed-tier economics (--compare-tiers, on by default): the same
+    skew trace replayed at fp32 and at int8 + transmittance pruning under
+    the SAME constrained byte budget — bytes-per-entry, cache entries per
+    GiB (the capacity-per-byte claim: >= 3x fp32 at int8+pruning), and the
+    hit rate each tier buys; both rows land in the perf ledger on
+    tier-keyed streams (`fleet_cache_economics`, gated by
+    `perf_ledger.py check` via the cache_entries_per_gib/cache_hit_rate
+    rules),
+  * the peer-fetch proof: after a membership change (the owner replica
+    ejected from the ROUTER ring, still alive as a peer), every request
+    for its images lands on a replica that never saw them — which serves
+    them from the ejected owner's cache over GET /mpi/<key> with ZERO new
+    encoder invocations (fleet-wide encoder_invocations == M still holds).
 
-Replicas default to FAKE engines (serving/fake.py — the control plane is
-what this bench measures; an XLA render would swamp the routing numbers
-with model FLOPs and cost K compiles). --real switches to real random-init
-engines for an end-to-end-with-XLA measurement.
+Replicas default to FAKE engines (serving/fake.py — digest-seeded slabs
+with a realistic transmittance falloff, so compression ratios and pruning
+are meaningful; an XLA render would swamp the routing numbers with model
+FLOPs and cost K compiles). --real switches to real random-init engines
+for an end-to-end-with-XLA measurement.
 
 Prints exactly one JSON line (bench.py contract); the run() core is
 importable for the tier-1 smoke.
 
   python tools/bench_fleet.py                          # 3 fake replicas
   python tools/bench_fleet.py --replicas 5 --requests 400
+  python tools/bench_fleet.py --tier int8 --prune-eps 1e-3
 """
 
 from __future__ import annotations
@@ -46,6 +62,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 METRIC = "fleet_renders_per_sec"
+ECON_METRIC = "fleet_cache_economics"
+BENCH_PLANES = 8  # enough planes that pruning has something to prune
 
 
 def _make_pngs(n: int, size: int = 8) -> list[bytes]:
@@ -82,7 +100,18 @@ def _metric_value(text: str, name: str, default=0.0) -> float:
     return total if seen else default
 
 
-def _real_replica_app():
+def _bench_cfg(tier: str, prune_eps: float):
+    from mine_tpu.config import Config
+
+    return Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128,
+        "mpi.num_bins_coarse": BENCH_PLANES,
+        "serving.cache_tier": tier,
+        "serving.prune_transmittance_eps": prune_eps,
+    })
+
+
+def _real_replica_app(tier: str, prune_eps: float, cache_mb: int):
     import jax
 
     from mine_tpu.config import Config
@@ -93,6 +122,8 @@ def _real_replica_app():
         "data.name": "synthetic", "data.img_h": 128, "data.img_w": 128,
         "model.num_layers": 18, "model.dtype": "float32",
         "mpi.num_bins_coarse": 2,
+        "serving.cache_tier": tier,
+        "serving.prune_transmittance_eps": prune_eps,
     })
     model = build_model(cfg)
     variables = model.init(
@@ -100,7 +131,8 @@ def _real_replica_app():
         jax.numpy.linspace(1.0, 0.01, 2)[None], True,
     )
     return ServingApp(cfg, variables["params"],
-                      variables.get("batch_stats", {}), max_delay_ms=0.0)
+                      variables.get("batch_stats", {}), max_delay_ms=0.0,
+                      cache_bytes=cache_mb << 20)
 
 
 def run(
@@ -110,8 +142,20 @@ def run(
     concurrency: int = 6,
     real: bool = False,
     vnodes: int = 64,
+    tier: str = "fp32",
+    prune_eps: float = 0.0,
+    cache_mb: int = 2048,
+    peer_fetch: bool = True,
+    strict_cached: bool = True,
+    membership_proof: bool = True,
 ) -> dict:
-    """The measurement core; returns the result dict (no printing)."""
+    """The measurement core; returns the result dict (no printing).
+
+    strict_cached=False tolerates seeded predicts falling out of a
+    too-small cache (the tier-economics runs constrain the budget ON
+    PURPOSE — an fp32 re-encode under pressure is the measured effect,
+    not an error).
+    """
     import numpy as np
 
     from mine_tpu.serving.fake import make_fake_app
@@ -121,13 +165,25 @@ def run(
     apps, servers, urls = [], [], {}
     try:
         for i in range(replicas):
-            app = _real_replica_app() if real else make_fake_app()
+            if real:
+                app = _real_replica_app(tier, prune_eps, cache_mb)
+            else:
+                app = make_fake_app(cfg=_bench_cfg(tier, prune_eps),
+                                    cache_bytes=cache_mb << 20)
             srv = make_server(app)
             host, port = srv.server_address[:2]
             threading.Thread(target=srv.serve_forever, daemon=True).start()
             apps.append(app)
             servers.append(srv)
             urls[f"r{i}"] = f"http://{host}:{port}"
+        if peer_fetch:
+            # fleet membership for the compressed wire: each replica knows
+            # every base URL (its own included) and fetches a missing key
+            # from the digest's more-authoritative peers (GET /mpi/<key>);
+            # the vnode count must match the router's so both sides agree
+            # on candidate order
+            for i, app in enumerate(apps):
+                app.configure_peers(urls, f"r{i}", vnodes=vnodes)
         fleet = FleetApp(urls, probe_interval_s=1.0, vnodes=vnodes).start()
         fleet_srv = make_fleet_server(fleet)
         fh, fp = fleet_srv.server_address[:2]
@@ -138,11 +194,17 @@ def run(
         # seed: every image predicted once through the router (the fleet's
         # steady state: the working set resident, one arc per digest)
         keys: list[str] = []
+        entry_bytes: list[float] = []
+        planes_kept: list[int] = []
         for png in pngs:
             code, body = _http(base, "/predict", data=png,
                                headers={"Content-Type": "image/png"})
             assert code == 200, body
-            keys.append(json.loads(body)["mpi_key"])
+            resp = json.loads(body)
+            keys.append(resp["mpi_key"])
+            entry_bytes.append(float(resp["mpi_bytes"]))
+            planes_kept.append(int(resp.get("planes_kept",
+                                            resp.get("planes", 0))))
 
         # skewed popularity (~1/rank): the realistic trace shape — a few
         # hot images dominate, the tail keeps every replica's arc warm
@@ -150,8 +212,8 @@ def run(
         weights = 1.0 / np.arange(1, images + 1)
         weights /= weights.sum()
         picks = rng.choice(images, size=requests, p=weights)
-        # every request = a cache-hitting /predict (affinity check) + a
-        # one-pose /render; payloads precomputed outside the timed window
+        # every request = a /predict (cache-affinity check) + a one-pose
+        # /render; payloads precomputed outside the timed window
         work = [
             (pngs[i], json.dumps({
                 "mpi_key": keys[i], "offsets": [[0.01, 0.0, 0.0]],
@@ -161,26 +223,46 @@ def run(
         work_lock = threading.Lock()
         latencies: list[float] = []
         errors: list[str] = []
+        uncached = [0]
+
+        pressure_404 = [0]
 
         def client():
+            hdr_png = {"Content-Type": "image/png"}
+            hdr_json = {"Content-Type": "application/json"}
             while True:
                 with work_lock:
                     if not work:
                         return
                     png, render_payload = work.pop()
                 t0 = time.perf_counter()
-                c1, b1 = _http(base, "/predict", data=png,
-                               headers={"Content-Type": "image/png"})
+                c1, b1 = _http(base, "/predict", data=png, headers=hdr_png)
                 c2, _ = _http(base, "/render", data=render_payload,
-                              headers={"Content-Type": "application/json"})
+                              headers=hdr_json)
+                if c2 == 404 and not strict_cached:
+                    # cache pressure evicted the entry between predict and
+                    # render (the documented client contract: re-predict,
+                    # render again) — under a deliberately tiny budget a
+                    # concurrent eviction can still win the race, which is
+                    # MEASURED as pressure, not an error
+                    c1b, _ = _http(base, "/predict", data=png,
+                                   headers=hdr_png)
+                    c2, _ = _http(base, "/render", data=render_payload,
+                                  headers=hdr_json)
+                    c1 = c1 if c1b == 200 else c1b
                 dt = time.perf_counter() - t0
                 with work_lock:
                     if c1 == 200 and c2 == 200:
                         latencies.append(dt)
+                    elif c2 == 404 and not strict_cached:
+                        pressure_404[0] += 1
                     else:
                         errors.append(f"predict={c1} render={c2}")
                     if c1 == 200 and not json.loads(b1)["cached"]:
-                        errors.append("seeded predict missed the cache")
+                        if strict_cached:
+                            errors.append("seeded predict missed the cache")
+                        else:
+                            uncached[0] += 1
 
         clients = [threading.Thread(target=client)
                    for _ in range(concurrency)]
@@ -195,22 +277,31 @@ def run(
                 f"{len(errors)}/{requests} fleet requests failed: {errors[0]}"
             )
 
-        # per-replica concentration from each replica's own counters
-        per_replica = []
-        total_encoders = total_hits = total_misses = 0.0
-        for name, url in urls.items():
-            _, body = _http(url, "/metrics")
-            text = body.decode()
-            enc = _metric_value(text, "mine_serve_encoder_invocations_total")
-            hits = _metric_value(text, "mine_serve_cache_hits_total")
-            misses = _metric_value(text, "mine_serve_cache_misses_total")
-            total_encoders += enc
-            total_hits += hits
-            total_misses += misses
-            per_replica.append({
-                "replica": name, "encoder_invocations": enc,
-                "cache_hits": hits, "cache_misses": misses,
-            })
+        def fleet_counters():
+            per_replica = []
+            enc = hits = misses = fetch_hits = 0.0
+            for name, url in urls.items():
+                _, body = _http(url, "/metrics")
+                text = body.decode()
+                e = _metric_value(text, "mine_serve_encoder_invocations_total")
+                h = _metric_value(text, "mine_serve_cache_hits_total")
+                m = _metric_value(text, "mine_serve_cache_misses_total")
+                f = _metric_value(
+                    text, 'mine_fleet_peer_fetch_total{outcome="hit"}')
+                enc += e
+                hits += h
+                misses += m
+                fetch_hits += f
+                per_replica.append({
+                    "replica": name, "encoder_invocations": e,
+                    "cache_hits": h, "cache_misses": m,
+                    "peer_fetch_hits": f,
+                })
+            return per_replica, enc, hits, misses, fetch_hits
+
+        per_replica, total_encoders, total_hits, total_misses, _ = (
+            fleet_counters()
+        )
         _, body = _http(base, "/metrics")
         fleet_text = body.decode()
 
@@ -221,6 +312,7 @@ def run(
             "replicas": replicas, "images": images,
             "requests": requests, "concurrency": concurrency,
             "engine": "real" if real else "fake",
+            "tier": tier, "prune_eps": prune_eps, "cache_mb": cache_mb,
             "elapsed_s": round(elapsed, 2),
             "router_p50_ms": round(
                 1e3 * float(np.percentile(latencies, 50)), 1),
@@ -231,17 +323,68 @@ def run(
             "encoder_invocations_total": total_encoders,
             "cache_hit_rate": round(
                 total_hits / max(total_hits + total_misses, 1.0), 4),
+            "uncached_predicts": uncached[0],
+            "pressure_404s": pressure_404[0],
+            # compressed-tier economics (serving/compress.py): what one
+            # cached scene costs and how many a GiB holds at this tier
+            "bytes_per_entry": round(float(np.mean(entry_bytes)), 1),
+            "cache_entries_per_gib": round(
+                (1 << 30) / max(float(np.mean(entry_bytes)), 1.0), 1),
+            "planes_kept_mean": round(float(np.mean(planes_kept)), 2),
             "per_replica": per_replica,
             "failovers": _metric_value(
                 fleet_text, "mine_fleet_failovers_total"),
             "note": (
                 "end-to-end through router+replica HTTP; every request = "
-                "cache-hitting predict + 1-pose render; fake engines "
+                "predict (affinity check) + 1-pose render; fake engines "
                 "isolate routing/control-plane cost" if not real else
                 "end-to-end through router+replica HTTP with real XLA "
                 "render dispatches"
             ),
         }
+
+        if membership_proof and peer_fetch and not real:
+            # ---- the peer-fetch proof ------------------------------------
+            # Membership change: eject r0 from the ROUTER's view (a new
+            # ring without it — exactly what the health gate builds when a
+            # replica sheds), while r0 stays alive as a PEER. Every one of
+            # r0's images now routes to a replica that never saw it; with
+            # the compressed wire that replica adopts r0's cached MPI
+            # (GET /mpi/<key>) instead of re-running the encoder.
+            from mine_tpu.serving.fleet import FleetApp as _FleetApp
+
+            import hashlib
+
+            survivors = {n: u for n, u in urls.items() if n != "r0"}
+            router2 = _FleetApp(survivors, vnodes=vnodes)  # no probes
+            for i, png in enumerate(pngs):
+                status, _, resp_body, _ = router2.forward(
+                    hashlib.sha256(png).hexdigest(),
+                    "POST", "/predict", png,
+                    {"Content-Type": "image/png"},
+                )
+                assert status == 200, resp_body
+                assert json.loads(resp_body)["mpi_key"] == keys[i]
+                # and the MPI is renderable where it landed
+                status, _, _, _ = router2.forward(
+                    keys[i].split(":", 1)[0], "POST", "/render",
+                    json.dumps({"mpi_key": keys[i],
+                                "offsets": [[0.01, 0.0, 0.0]]}).encode(),
+                    {"Content-Type": "application/json"},
+                )
+                assert status == 200
+            _, enc_after, _, _, fetch_hits = fleet_counters()
+            result["peer_fetch_proof"] = {
+                "ejected": "r0",
+                "images_replayed": images,
+                # the acceptance claim: encoder count did NOT move — every
+                # relocated image was served from a peer's cache
+                "encoder_invocations_after": enc_after,
+                "encoder_invocations_delta": enc_after - total_encoders,
+                "peer_fetch_hits": fetch_hits,
+                "ok": (enc_after == total_encoders == float(images)
+                       and fetch_hits > 0),
+            }
         return result
     finally:
         for srv in servers:
@@ -257,6 +400,107 @@ def run(
             app.close()
 
 
+def run_tier_compare(
+    replicas: int = 3,
+    images: int = 12,
+    requests: int = 150,
+    concurrency: int = 6,
+    tier: str = "int8",
+    prune_eps: float | None = None,
+    cache_mb: int | None = None,
+) -> dict:
+    """The same skew trace at fp32 and at `tier`+pruning under one
+    CONSTRAINED byte budget: capacity-per-byte ratio and the hit rate each
+    representation buys. Returns {"fp32": run, tier: run, "capacity_x_fp32",
+    "hit_rate_gain"}."""
+    from mine_tpu.serving.compress import DEFAULT_PRUNE_EPS
+
+    if prune_eps is None:
+        prune_eps = DEFAULT_PRUNE_EPS
+    if cache_mb is None:
+        # sized to thrash fp32 (~2.1 MB/entry at 128^2 S=8: holds ~4 of
+        # `images` scenes) while the compressed tier fits comfortably
+        cache_mb = 8
+    common = dict(replicas=replicas, images=images, requests=requests,
+                  concurrency=concurrency, cache_mb=cache_mb,
+                  strict_cached=False, membership_proof=False)
+    base = run(tier="fp32", prune_eps=0.0, **common)
+    compact = run(tier=tier, prune_eps=prune_eps, **common)
+    return {
+        "fp32": base,
+        tier: compact,
+        "cache_mb": cache_mb,
+        "capacity_x_fp32": round(
+            base["bytes_per_entry"] / max(compact["bytes_per_entry"], 1.0), 2
+        ),
+        "hit_rate_gain": round(
+            compact["cache_hit_rate"] - base["cache_hit_rate"], 4),
+    }
+
+
+def _append_ledger_rows(result: dict, compare: dict | None,
+                        args, compare_tier: str | None = None) -> list[dict]:
+    """The dedicated fleet stream + one tier-keyed economics stream per
+    compared tier; p95/hit-rate/capacity fields are AUX_METRICS, so
+    `perf_ledger.py check` gates them (and the chaos drill's final verdict
+    inherits the gate)."""
+    import jax
+
+    from mine_tpu.obs import ledger
+
+    rows = []
+    device = jax.devices()[0].device_kind
+    backend = jax.default_backend()
+    workload = {
+        "replicas": args.replicas, "images": args.images,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "engine": result["engine"],
+    }
+    # the fp32/no-prune default OMITS the tier keys, so the headline
+    # stream's config_digest — and its pre-compression rolling baseline —
+    # carries over (the same omit-at-default idiom as mesh_shape,
+    # obs/ledger.py stream_key); a non-default tier is a genuinely
+    # different workload and keys its own stream
+    if result["tier"] != "fp32" or result["prune_eps"]:
+        workload["tier"] = result["tier"]
+        workload["prune_eps"] = result["prune_eps"]
+    if result["cache_mb"] != 2048:
+        # a constrained budget is a different workload too: its collapsed
+        # hit rate must not poison the default stream's rolling baseline
+        workload["cache_mb"] = result["cache_mb"]
+    row = ledger.append_bench_row({
+        "metric": METRIC, "value": result["value"],
+        "unit": "renders/sec", "higher_is_better": True,
+        "p50_ms": result["router_p50_ms"],
+        "p95_ms": result["router_p95_ms"],
+        "cache_hit_rate": result["cache_hit_rate"],
+        "cache_entries_per_gib": result["cache_entries_per_gib"],
+        "device": device, "backend": backend,
+    }, workload=workload)
+    if row is not None:
+        rows.append(row)
+    if compare is not None:
+        for tier_name in ("fp32", compare_tier):
+            r = compare[tier_name]
+            row = ledger.append_bench_row({
+                "metric": ECON_METRIC,
+                "value": r["cache_entries_per_gib"],
+                "unit": "entries/GiB", "higher_is_better": True,
+                "cache_hit_rate": r["cache_hit_rate"],
+                "p95_ms": r["router_p95_ms"],
+                "device": device, "backend": backend,
+            }, workload={
+                "replicas": args.replicas, "images": args.images,
+                "requests": args.requests,
+                "concurrency": args.concurrency,
+                "engine": r["engine"], "tier": r["tier"],
+                "prune_eps": r["prune_eps"], "cache_mb": r["cache_mb"],
+            })
+            if row is not None:
+                rows.append(row)
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--replicas", type=int, default=3)
@@ -266,40 +510,67 @@ def main() -> None:
     ap.add_argument("--real", action="store_true",
                     help="real random-init engines instead of fake ones "
                     "(costs one XLA compile per replica)")
+    ap.add_argument("--tier", default="fp32",
+                    choices=("fp32", "bf16", "int8"),
+                    help="cache tier for the MAIN trace run (default fp32 "
+                    "keeps the headline fleet-stream workload — and its "
+                    "ledger baseline — steady; the economics pass below "
+                    "covers the compressed tiers)")
+    ap.add_argument("--compare-tier", default="int8",
+                    choices=("bf16", "int8"),
+                    help="tier for the fp32-vs-tier economics pass")
+    ap.add_argument("--prune-eps", type=float, default=None,
+                    help="transmittance pruning threshold (default: "
+                    "compress.DEFAULT_PRUNE_EPS for non-fp32 tiers)")
+    ap.add_argument("--cache-mb", type=int, default=2048)
+    ap.add_argument("--no-peer-fetch", action="store_true")
+    ap.add_argument("--no-compare-tiers", action="store_true",
+                    help="skip the fp32-vs-tier economics pass")
     args = ap.parse_args()
 
     from mine_tpu.utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
 
+    from mine_tpu.serving.compress import DEFAULT_PRUNE_EPS
+
+    prune_eps = (args.prune_eps if args.prune_eps is not None
+                 else (DEFAULT_PRUNE_EPS if args.tier != "fp32" else 0.0))
     result = run(
         replicas=args.replicas, images=args.images,
         requests=args.requests, concurrency=args.concurrency,
-        real=args.real,
+        real=args.real, tier=args.tier, prune_eps=prune_eps,
+        cache_mb=args.cache_mb, peer_fetch=not args.no_peer_fetch,
     )
+    compare = None
+    if not args.no_compare_tiers and not args.real:
+        compare_eps = (args.prune_eps if args.prune_eps is not None
+                       else DEFAULT_PRUNE_EPS)
+        compare = run_tier_compare(
+            replicas=args.replicas, images=args.images,
+            requests=args.requests, concurrency=args.concurrency,
+            tier=args.compare_tier, prune_eps=compare_eps,
+        )
+        result["tier_compare"] = {
+            "cache_mb": compare["cache_mb"],
+            "capacity_x_fp32": compare["capacity_x_fp32"],
+            "hit_rate_gain": compare["hit_rate_gain"],
+            "fp32": {k: compare["fp32"][k] for k in (
+                "bytes_per_entry", "cache_entries_per_gib",
+                "cache_hit_rate", "encoder_invocations_total")},
+            args.compare_tier: {k: compare[args.compare_tier][k] for k in (
+                "bytes_per_entry", "cache_entries_per_gib",
+                "cache_hit_rate", "encoder_invocations_total",
+                "planes_kept_mean")},
+        }
 
-    # perf ledger (obs/ledger.py): the DEDICATED fleet stream — metric name
-    # + workload digest keep it disjoint from single-replica serve rows;
-    # p95_ms is an AUX_METRICS field, so `perf_ledger.py check` gates it
+    # perf ledger (obs/ledger.py): the dedicated fleet stream + the
+    # tier-keyed economics streams ("before/after" rows)
     try:
-        import jax
-
-        from mine_tpu.obs import ledger
-
-        row = ledger.append_bench_row({
-            "metric": METRIC, "value": result["value"],
-            "unit": "renders/sec", "higher_is_better": True,
-            "p50_ms": result["router_p50_ms"],
-            "p95_ms": result["router_p95_ms"],
-            "device": jax.devices()[0].device_kind,
-            "backend": jax.default_backend(),
-        }, workload={
-            "replicas": args.replicas, "images": args.images,
-            "requests": args.requests, "concurrency": args.concurrency,
-            "engine": result["engine"],
-        })
-        if row is not None:
-            result["ledger_row"] = row
+        rows = _append_ledger_rows(result, compare, args,
+                                   compare_tier=args.compare_tier)
+        if rows:
+            result["ledger_rows"] = len(rows)
     except Exception as exc:  # noqa: BLE001 - the number outranks the ledger
         print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
 

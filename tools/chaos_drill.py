@@ -624,6 +624,11 @@ def main(argv: list[str] | None = None) -> int:
                 "rows": lv["rows"], "regressions": lv["regressions"],
                 "streams_checked": len(lv["checked"]),
                 "streams_skipped": len(lv["skipped"]),
+                # stream names, so the verdict shows WHAT is gated — the
+                # fleet stream plus the compressed-tier economics streams
+                # (fleet_cache_economics: capacity-per-byte + hit rate,
+                # obs/ledger.py AUX_METRICS) ride the same check
+                "streams": sorted({c["metric"] for c in lv["checked"]}),
                 "failures": [
                     {**{k: c[k] for k in
                         ("metric", "device", "backend_class")},

@@ -17,7 +17,7 @@ scene gives held-out ground truth for free.
 
 Usage:
   python tools/convergence_run.py --steps 800 --eval-every 100 \
-      --out workspace/convergence
+      --out workspace/artifacts/convergence
 Writes <out>/curve.jsonl ({"step", "loss", "psnr_novel", ...} per eval) and
 prints a final JSON summary line.
 """
@@ -143,7 +143,7 @@ def main() -> None:
                          "(0.25 aligns planes exactly with the scene's two "
                          "surfaces; measured no PSNR gain over 0.2 — "
                          "BASELINE.md r4 ablation)")
-    ap.add_argument("--out", default="workspace/convergence")
+    ap.add_argument("--out", default="workspace/artifacts/convergence")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--eval-phases", type=int, default=1, choices=(1, 2, 3),
                     help="held-out scenes to average the eval over "

@@ -84,6 +84,9 @@ def main() -> None:
     ap.add_argument("--height", type=int, default=128)
     ap.add_argument("--width", type=int, default=128)
     ap.add_argument("--disparity-end", type=float, default=0.2)
+    ap.add_argument("--out", default="workspace/artifacts/disocclusion.json",
+                    help="also write the JSON line here (the measurement "
+                    "artifact home; empty disables the file copy)")
     args = ap.parse_args()
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -176,7 +179,12 @@ def main() -> None:
     out["inpainting_gain_db"] = round(
         out["trained_disoccluded"] - out["oracle_disoccluded"], 3
     )
-    print(json.dumps(out))
+    line = json.dumps(out)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+    print(line)
 
 
 if __name__ == "__main__":

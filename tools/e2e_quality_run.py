@@ -43,7 +43,7 @@ def main() -> None:
     ap.add_argument("--n-views", type=int, default=12)
     ap.add_argument("--n-val-views", type=int, default=3)
     ap.add_argument("--size", type=int, default=128)
-    ap.add_argument("--out", default="workspace/e2e_quality")
+    ap.add_argument("--out", default="workspace/artifacts/e2e_quality")
     args = ap.parse_args()
 
     from mine_tpu.data.synthetic import write_colmap_scene

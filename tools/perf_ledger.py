@@ -10,9 +10,12 @@ tools/bench_serve.py, and tools/bench_accum.py. This tool reads it:
           stream; rows without mesh_shape are the single-device legacy
           stream) against the
           median of its prior rows; exit 1 when any checked field —
-          value, p95_ms, peak_hbm_bytes — regressed beyond --threshold.
+          value, p95_ms, peak_hbm_bytes, cache_entries_per_gib,
+          cache_hit_rate (the compressed-MPI fleet economics rows
+          tools/bench_fleet.py appends) — regressed beyond --threshold.
           Streams with < --min-history prior rows are skipped, not
-          failed. Prints one JSON verdict line (bench.py discipline).
+          failed (the same min-history rule for every stream). Prints
+          one JSON verdict line (bench.py discipline).
   show    print the rows (optionally --metric filtered), one per line.
   append  append a row from --json '{"metric": ..., "value": ...,
           "workload": {...}}' — for wiring external measurements in.
