@@ -135,6 +135,20 @@ class TrainingConfig:
     # batch decouples from HBM (training/step.py). Must divide
     # data.per_gpu_batch_size. 1 = the plain single-pass step.
     accum_steps: int = 1
+    # auto-resume target: "latest" (newest retained checkpoint, the classic
+    # behavior) or "last_good" (newest retained step at-or-under the
+    # sentinel-vetted pointer, training/checkpoint.py restore_last_good —
+    # what an ELASTIC restart after a host loss should trust: the newest
+    # step may be a partially-committed or unvetted save from the dying
+    # run). Fresh workspaces start at 0 either way.
+    resume_from: str = "latest"
+    # "adam" (the reference's two-group Adam, the default) or "sgd" (same
+    # two LR groups, no moments). SGD exists for cross-topology parity
+    # methodology — Adam's first-step sign(grad)*lr amplifies
+    # fp-reassociation noise on zero-effective-grad leaves into full ±lr
+    # flips (PARITY.md 4.x, tests/test_parallel.py), so elastic-resume
+    # equivalence drills compare under SGD
+    optimizer: str = "adam"
     log_interval: int = 10  # reference hardcodes 10 (synthesis_task.py:638)
     checkpoint_interval: int = 5000  # reference hardcodes 5000 (:645)
     lpips_weights_path: str = ""  # .npz from tools/convert_lpips.py
@@ -208,6 +222,29 @@ class ResilienceConfig:
     breaker_failure_threshold: int = 5
     # seconds the breaker stays open before half-opening for one trial
     breaker_reset_s: float = 30.0
+    # cross-host stall watchdog (resilience/multihost.py): on multi-process
+    # runs every host writes a heartbeat file at each log-interval sync; a
+    # host whose heartbeat goes stale by more than this window — killed, or
+    # stuck in a collective — makes EVERY host (survivors and, if alive,
+    # the stuck one itself) write a flight dump and exit with the named
+    # abort code instead of hanging in NCCL/ICI forever. Size it to at
+    # least 2x the slowest legitimate gap between heartbeats (log interval
+    # wall time, checkpoint saves, eval passes). 0 disables the watchdog;
+    # heartbeats are still written whenever process_count > 1.
+    multihost_watchdog_s: float = 0.0
+    # where heartbeat files live; must be storage every host can read
+    # (one box: any shared dir; a pod: NFS or similar — a gs:// workspace
+    # cannot carry them, plain file IO). Empty = <workspace sidecar>/
+    # heartbeats, correct for single-box multi-process and local shared
+    # filesystems.
+    multihost_heartbeat_dir: str = ""
+    # retrying bring-up (resilience/multihost.py bring_up): attempts for
+    # fast bring-up failures (coordinator not yet up / connection refused)
+    # with exponential backoff. A bring-up TIMEOUT is terminal regardless —
+    # the stuck rendezvous thread cannot be torn down in-process, so the
+    # process must be rescheduled (parallel/mesh.py MultihostInitTimeout).
+    multihost_bringup_attempts: int = 3
+    multihost_bringup_backoff_s: float = 2.0
 
 
 @dataclass(frozen=True)

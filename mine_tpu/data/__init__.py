@@ -1,4 +1,8 @@
 """Data pipelines (reference: input_pipelines/)."""
 
-from mine_tpu.data.pipeline import TransientLoaderError, prefetch
+from mine_tpu.data.pipeline import (
+    LoaderRetriesExhausted,
+    TransientLoaderError,
+    prefetch,
+)
 from mine_tpu.data.synthetic import SyntheticDataset, make_synthetic_batch

@@ -42,6 +42,25 @@ class TransientLoaderError(RuntimeError):
     """A loader error worth retrying (the pipeline's opt-in marker)."""
 
 
+class LoaderRetriesExhausted(RuntimeError):
+    """Bounded retries ran out: the NAMED terminal error of the retry
+    machinery (`data.loader_retries`). Carries the attempt count and
+    chains the last underlying error, so a pod-scale log line says "host
+    retried the flaky mount 3x and gave up" instead of surfacing the raw
+    OSError (or, worse, a bare StopIteration swallowed by generator
+    machinery) with no hint that retries already happened. Raised only
+    when retries were actually configured — `retries=0` keeps fail-fast
+    semantics and relays the original error untouched."""
+
+    def __init__(self, attempts: int, cause: BaseException):
+        super().__init__(
+            f"loader retries exhausted after {attempts} attempt(s); last "
+            f"error: {type(cause).__name__}: {cause}"
+        )
+        self.attempts = attempts
+        self.cause = cause
+
+
 # what the bounded retry treats as transient; anything else re-raises at
 # the consumer immediately (a shape bug retried 3 times is 3x the noise)
 _RETRYABLE = (TransientLoaderError, chaos.ChaosFault, OSError, TimeoutError)
@@ -69,6 +88,10 @@ def _retrying(
             return fn()
         except _RETRYABLE as exc:
             if attempt >= retries:
+                if retries > 0:
+                    # retries were configured and ran out: name it
+                    # (module docstring; retries=0 stays fail-fast raw)
+                    raise LoaderRetriesExhausted(attempt + 1, exc) from exc
                 raise
             # exponential backoff with jitter: correlated retries from
             # many hosts must not re-stampede the storage that just

@@ -156,7 +156,11 @@ def write_colmap_scene(
 class SyntheticDataset:
     """Procedural dataset speaking the loader protocol (steps_per_epoch +
     epoch(n) iterator of batch pytrees). Zero disk footprint; every batch is
-    a fresh scene, deterministic in (seed, epoch, step)."""
+    a fresh scene, deterministic in (seed, epoch, step) — and, since every
+    example is seeded by its GLOBAL index, in the example alone: a host
+    materializing only its `host_slice` rows produces bitwise the rows a
+    global-batch load would slice out (the per-host data-sharding contract,
+    parallel/mesh.py host_batch_slice; PARITY.md)."""
 
     def __init__(
         self,
@@ -166,6 +170,7 @@ class SyntheticDataset:
         steps_per_epoch: int = 50,
         n_points: int = 256,
         seed: int = 0,
+        host_slice: tuple[int, int] | None = None,
     ):
         self.height = height
         self.width = width
@@ -173,18 +178,31 @@ class SyntheticDataset:
         self.steps_per_epoch = steps_per_epoch
         self.n_points = n_points
         self.seed = seed
+        # (start, count) of the global batch THIS host materializes per
+        # step; None = the whole batch (single-process, and the
+        # global-load-then-slice compat path)
+        if host_slice is not None:
+            start, count = host_slice
+            if start < 0 or count < 1 or start + count > global_batch:
+                raise ValueError(
+                    f"host_slice={host_slice} outside the global batch "
+                    f"of {global_batch}"
+                )
+        self.host_slice = host_slice
 
     def __len__(self) -> int:
         return self.steps_per_epoch
 
     def epoch(self, epoch: int):
+        start, count = self.host_slice or (0, self.global_batch)
         for step in range(self.steps_per_epoch):
             batch = make_synthetic_batch(
-                self.global_batch,
+                count,
                 self.height,
                 self.width,
                 n_points=self.n_points,
                 seed=self.seed + epoch * 1_000_003 + step,
+                example_offset=start,
             )
             batch.pop("src_depth")
             yield batch
@@ -197,13 +215,21 @@ def make_synthetic_batch(
     n_points: int = 64,
     seed: int = 0,
     baseline: float = 0.08,
+    example_offset: int = 0,
 ) -> dict[str, np.ndarray]:
     """Batch pytree in the training-step contract (mine_tpu/training/step.py).
 
     The target camera is the source camera translated by `baseline` along +x
     (and a touch of +y), like an LLFF stereo pair.
+
+    Every example draws from its OWN generator seeded by (seed,
+    example_offset + row): example content is a pure function of its
+    global index, never of which rows happen to share the array — so
+    `make_synthetic_batch(n, ..., example_offset=s)` is bitwise the rows
+    [s:s+n] of the full batch, and a multi-host run where each host
+    materializes only its slice sees exactly the global stream
+    (the data-sharding numerics no-op, PARITY.md).
     """
-    rng = np.random.default_rng(seed)
     k = _intrinsics(height, width)
 
     out = {
@@ -217,6 +243,7 @@ def make_synthetic_batch(
         "src_depth": np.zeros((batch_size, height, width), np.float32),
     }
     for b in range(batch_size):
+        rng = np.random.default_rng([seed, example_offset + b])
         phase = float(rng.uniform(0.0, 6.28))
         src_pos = np.zeros(3)
         tgt_pos = np.array([baseline, 0.3 * baseline, 0.0])

@@ -14,9 +14,14 @@ makes the next dead-tunnel, preemption, or silent stall dump its state:
     watchdog re-arms only after the heartbeat resumes (plus a minimum
     inter-dump interval, so a wedged run cannot fill the disk).
 
-A dump is a directory `flight_<utc>_<reason>/` under `dump_dir` (training
-passes the workspace sidecar dir from training/checkpoint.py
-local_sidecar_dir, so remote `gs://` workspaces still get local evidence):
+A dump is a directory `<proc>/flight_<utc>_<reason>/` under `dump_dir`
+(training passes the workspace sidecar dir from training/checkpoint.py
+local_sidecar_dir, so remote `gs://` workspaces still get local evidence).
+`<proc>` keys the dump by process — `p<process_index>-<pid>` when a jax
+backend is up, `pid<pid>` otherwise — so N processes sharing a sidecar
+(the multi-host harness, a pod with an NFS workspace) write to DISJOINT
+subdirectories instead of interleaving `stacks.txt` bytes in one
+timestamp-named directory:
 
   stacks.txt  — all-thread Python stacks via faulthandler.
   spans.json  — the tracer's last-K spans plus this thread's open spans.
@@ -49,6 +54,23 @@ def _span_dict(s: Span) -> dict:
         "dur_us": round(s.dur_us, 1), "tid": s.tid,
         "thread": s.thread_name, "depth": s.depth, "args": s.args,
     }
+
+
+def _process_key() -> str:
+    """The per-process dump-subdirectory name. Reads jax.process_index()
+    only when a backend is ALREADY up (same no-initialize discipline as
+    _device_memory_stats — a flight dump on a dead tunnel must not hang on
+    the backend that killed the run); the pid keeps two backend-less
+    processes disjoint regardless."""
+    pid = os.getpid()
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            if jax._src.xla_bridge._backends:  # noqa: SLF001 - no public probe
+                return f"p{jax.process_index()}-{pid}"
+        except Exception:  # noqa: BLE001 - private surface may move
+            pass
+    return f"pid{pid}"
 
 
 def _device_memory_stats() -> Any:
@@ -184,7 +206,7 @@ class FlightRecorder:
         try:
             stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
             path = os.path.join(
-                self.dump_dir, f"flight_{stamp}_{reason}"
+                self.dump_dir, _process_key(), f"flight_{stamp}_{reason}"
             )
             # a second dump in the same second must not clobber the first
             base, n = path, 1

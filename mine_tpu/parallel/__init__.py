@@ -13,6 +13,7 @@ from mine_tpu.parallel.mesh import (
     batch_sharding,
     data_replica_count,
     force_virtual_devices,
+    host_batch_slice,
     init_multihost,
     make_mesh,
     mesh_shape_str,

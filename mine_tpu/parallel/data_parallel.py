@@ -261,8 +261,27 @@ def make_parallel_eval_step(
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Place the train state replicated on every mesh device (the DDP initial
-    param broadcast, synthesis_task.py:110-115, done once, explicitly)."""
-    return jax.device_put(state, NamedSharding(mesh, _REPL))
+    param broadcast, synthesis_task.py:110-115, done once, explicitly).
+
+    Multi-process meshes: device_put rejects host arrays targeted at
+    non-addressable devices (exactly what a RESTORED checkpoint is — orbax
+    hands back host numpy, identical on every process), so each process
+    contributes its local replica copy via
+    jax.make_array_from_process_local_data instead. The single-process
+    path stays device_put: it also accepts already-on-device arrays (the
+    fresh-init case) without a host round trip."""
+    sharding = NamedSharding(mesh, _REPL)
+    if jax.process_count() == 1:
+        return jax.device_put(state, sharding)
+    import numpy as np
+
+    def put(x):
+        arr = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, arr, arr.shape
+        )
+
+    return jax.tree.map(put, state)
 
 
 def distribute_state(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
@@ -275,6 +294,19 @@ def distribute_state(state: TrainState, cfg: Config, mesh: Mesh) -> TrainState:
     — always saved gathered/layout-free — lands back in the live layout."""
     if not sharding_active(cfg, mesh):
         return replicate_state(state, mesh)
+    if jax.process_count() > 1:
+        # the table's sharded layouts (FSDP/ZeRO-1) place host arrays via
+        # device_put, which cannot target peers' devices — and gather-on-
+        # save (jax.device_get) cannot gather non-addressable shards
+        # either. Multi-host runs therefore train replicated today; the
+        # named error here beats the opaque device_put one.
+        raise NotImplementedError(
+            "multi-host + sharded state layouts (mesh.fsdp_parallel > 1 "
+            "or parallel.zero1) is not supported yet: checkpoints are "
+            "gathered on save, which requires every shard to be "
+            "process-addressable. Run multi-host jobs replicated "
+            "(data-parallel only) for now."
+        )
     return rules_mod.place_state(
         rules_mod.partition_rules(cfg), state, mesh,
         cfg.parallel.zero1_min_size,

@@ -90,6 +90,8 @@ def init_multihost(
     coordinator: str | None = None,
     timeout_s: float | None = None,
     initialize_fn=None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
 ) -> None:
     """Multi-host bootstrap (reference: torch.distributed.launch + NCCL TCP
     rendezvous, start_training.sh:75-83). On TPU pods jax.distributed
@@ -105,6 +107,15 @@ def init_multihost(
     peers on some single-chip environments (observed with tunneled TPU
     metadata), so it must never fire implicitly on single-host runs.
 
+    `num_processes`/`process_id` (or $MINE_TPU_MULTIHOST_NPROCS /
+    $MINE_TPU_MULTIHOST_PROC_ID) are required for manual topologies where
+    the cluster environment cannot supply them — the CPU multi-process
+    harness (tools/multihost_harness.py) is the canonical user: N
+    subprocesses on one box running THE SAME bring-up a pod runs. On a
+    forced-CPU platform with an explicit process count, cross-process
+    collectives are routed through gloo (the only CPU transport this
+    jax pins support) before the backend comes up.
+
     Bring-up deadline: the rendezvous runs on a worker thread joined for
     `timeout_s` (default $MINE_TPU_MULTIHOST_TIMEOUT_S, else 300). On
     expiry this raises MultihostInitTimeout instead of hanging the job
@@ -117,21 +128,51 @@ def init_multihost(
     import threading
     import warnings
 
-    if coordinator is None and not os.environ.get("MINE_TPU_MULTIHOST"):
-        return
+    if coordinator is None:
+        env = os.environ.get("MINE_TPU_MULTIHOST")
+        if not env:
+            return
+        # the env var doubles as the coordinator address (host:port, the
+        # harness's channel into subprocesses). Only a value SHAPED like
+        # an address (it contains ':') is treated as one — every other
+        # non-empty value keeps the pre-harness contract: opt in to
+        # cluster auto-detection (a launch script's "1"/"yes"/"on" must
+        # not get dialed as a hostname)
+        if ":" in env:
+            coordinator = env.strip()
+    if num_processes is None:
+        env_n = os.environ.get("MINE_TPU_MULTIHOST_NPROCS")
+        num_processes = int(env_n) if env_n else None
+    if process_id is None:
+        env_i = os.environ.get("MINE_TPU_MULTIHOST_PROC_ID")
+        process_id = int(env_i) if env_i else None
     if timeout_s is None:
         timeout_s = float(os.environ.get("MINE_TPU_MULTIHOST_TIMEOUT_S", 300))
     if initialize_fn is None:
         initialize_fn = jax.distributed.initialize
+        if num_processes is not None and (
+            os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        ):
+            # CPU multi-process collectives need the gloo transport; the
+            # flag is consumed at backend init, so set it here — the one
+            # place that runs before any backend touch on every bring-up
+            # path (production never passes initialize_fn; fakes skip this)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     outcome: list[BaseException | None] = []
 
     def bring_up():
+        kwargs: dict = {}
+        # only pass what the caller specified: injected test fakes (and
+        # cluster auto-detection) keep their narrow signatures
+        if coordinator:
+            kwargs["coordinator_address"] = coordinator
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
         try:
-            if coordinator:
-                initialize_fn(coordinator_address=coordinator)
-            else:
-                initialize_fn()
+            initialize_fn(**kwargs)
             outcome.append(None)
         except BaseException as e:  # noqa: BLE001 - re-raised on the caller
             outcome.append(e)
@@ -233,9 +274,96 @@ def batch_sharding(mesh: Mesh, rules: tuple | None = None) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
-def shard_batch(mesh: Mesh, batch: dict, rules: tuple | None = None) -> dict:
-    """device_put a host batch with the batch axis sharded over data x fsdp
-    (replaces the reference's per-process DistributedSampler slicing,
-    train.py:88 — here one logical batch spans the mesh)."""
+def host_batch_slice(
+    mesh: Mesh, global_rows: int, rules: tuple | None = None
+) -> tuple[int, int]:
+    """(start, count): the contiguous row range of the global batch that
+    THIS process's addressable devices own under the table's `^batch/` row
+    — what a per-host loader materializes instead of the whole global
+    batch (the reference's DistributedSampler role, computed from the
+    partition rules so the loader and the compiled step cannot disagree).
+
+    Single-process: (0, global_rows). Multi-process: the union of the
+    local devices' row slices, which must be contiguous and equal-sized
+    across processes (true for the in-order device-to-process layouts
+    jax.distributed produces; anything else is a hard error — a loader
+    cannot materialize a strided slice as one array)."""
     sharding = batch_sharding(mesh, rules)
-    return jax.device_put(batch, sharding)
+    if jax.process_count() == 1:
+        return 0, global_rows
+    local = [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+    idx_map = sharding.devices_indices_map((global_rows,))
+    rows = sorted(
+        {(idx_map[d][0].start or 0, idx_map[d][0].stop or global_rows)
+         for d in local}
+    )
+    start, stop = rows[0][0], rows[-1][1]
+    covered = sum(b - a for a, b in rows)
+    if covered != stop - start:
+        raise ValueError(
+            f"host {jax.process_index()}'s batch rows are not contiguous "
+            f"under the ^batch/ rule ({rows}); per-host loading needs an "
+            "in-order device-to-process mesh layout"
+        )
+    count = stop - start
+    if count * jax.process_count() != global_rows:
+        raise ValueError(
+            f"global batch {global_rows} does not split evenly over "
+            f"{jax.process_count()} processes (this host owns {count} rows)"
+        )
+    return start, count
+
+
+def shard_batch(
+    mesh: Mesh,
+    batch: dict,
+    rules: tuple | None = None,
+    global_rows: int | None = None,
+) -> dict:
+    """Place a host batch with the batch axis sharded over data x fsdp
+    (replaces the reference's per-process DistributedSampler slicing,
+    train.py:88 — here one logical batch spans the mesh).
+
+    Single-process: a plain device_put of the full batch. Multi-process:
+    each process contributes only its own rows
+    (jax.make_array_from_process_local_data — no host ever materializes
+    peers' data on device). The input may then be either
+
+      * this host's LOCAL slice (the per-host loader path — rows ==
+        host_batch_slice count), or
+      * the full GLOBAL batch (`global_rows` rows): the
+        global-load-then-slice compat path for loaders without per-host
+        support — sliced down here, numerically identical, just wasteful
+        host IO (PARITY.md).
+
+    `global_rows` disambiguates; None means the input is local."""
+    sharding = batch_sharding(mesh, rules)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    start, count = host_batch_slice(
+        mesh,
+        global_rows if global_rows is not None
+        else _leading_rows(batch) * jax.process_count(),
+        rules,
+    )
+
+    def put(x):
+        x = np.asarray(x)
+        if global_rows is not None and x.shape[0] == global_rows:
+            x = x[start:start + count]  # compat: global batch handed in
+        if x.shape[0] != count:
+            raise ValueError(
+                f"host batch has {x.shape[0]} rows; this host owns {count} "
+                f"of the global {global_rows} (host_batch_slice)"
+            )
+        gshape = (count * jax.process_count(),) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, gshape)
+
+    return jax.tree.map(put, batch)
+
+
+def _leading_rows(batch: dict) -> int:
+    leaves = jax.tree.leaves(batch)
+    if not leaves:
+        raise ValueError("empty batch")
+    return int(np.shape(leaves[0])[0])

@@ -13,6 +13,12 @@ Four pieces, wired through training, data, serving, and checkpointing:
                seams — the harness the tier-1 tests and
                tools/chaos_drill.py drive, so every behavior above is
                provable on CPU.
+  multihost.py multi-host survival — retrying jax.distributed bring-up,
+               heartbeat exchange over a shared directory, and the
+               cross-host stall watchdog that turns a dead/wedged host
+               into a bounded, named abort on every survivor (proven by
+               tools/multihost_harness.py + the chaos drill's multihost
+               half).
 
 Import-light on purpose: nothing here touches jax at import time (chaos
 seams sit on serving/data hot paths that must stay cheap when disabled).
@@ -20,6 +26,13 @@ seams sit on serving/data hot paths that must stay cheap when disabled).
 
 from mine_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
 from mine_tpu.resilience.chaos import ChaosFault, PreemptedError
+from mine_tpu.resilience.multihost import (
+    EXIT_HOST_STALL,
+    CrossHostWatchdog,
+    HeartbeatWriter,
+    HostStallAbort,
+    MultihostSurvival,
+)
 from mine_tpu.resilience.preempt import PreemptionGuard
 from mine_tpu.resilience.sentinel import (
     SentinelAbort,
@@ -32,6 +45,11 @@ __all__ = [
     "BreakerOpen",
     "ChaosFault",
     "CircuitBreaker",
+    "CrossHostWatchdog",
+    "EXIT_HOST_STALL",
+    "HeartbeatWriter",
+    "HostStallAbort",
+    "MultihostSurvival",
     "PreemptedError",
     "PreemptionGuard",
     "SentinelAbort",

@@ -49,6 +49,24 @@ Kinds and their seams:
                        response — exactly what a fleet router sees when a
                        replica dies mid-flood (proves failover + ring
                        convergence, tools/chaos_drill.py fleet half).
+  host_kill@step=N     training/loop.py SIGKILLs its own process after
+                       completing step N — a host dying mid-run. No flight
+                       dump, no preemption save, nothing: the evidence and
+                       the bounded exit must come from the SURVIVORS
+                       (resilience/multihost.py cross-host watchdog). Set
+                       only in the victim host's environment
+                       (tools/multihost_harness.py per-host fault specs).
+  host_stall@step=N    training/loop.py wedges THIS host after step N (an
+                       infinite sleep standing in for a hung collective /
+                       dead ICI link). Peers block at the next collective;
+                       every host's cross-host watchdog — including the
+                       stalled one's own — must dump and abort within the
+                       heartbeat window instead of hanging forever.
+  coord_down@init=N    resilience/multihost.py raises on the Nth bring-up
+                       ATTEMPT (invocation-keyed): the in-process stand-in
+                       for a coordinator that is not up yet when workers
+                       dial in — proves the retrying bring-up's backoff
+                       path deterministically.
 
 Two trigger styles share one `should()` call: value-keyed kinds (counter
 `step`) fire when the caller's `at=` equals the trigger; invocation-keyed
@@ -78,6 +96,9 @@ KINDS: dict[str, str] = {
     "predict_raise": "predict",
     "corrupt_swap": "swap",
     "replica_kill": "request",
+    "host_kill": "step",
+    "host_stall": "step",
+    "coord_down": "init",
 }
 _VALUE_KEYED = frozenset(k for k, c in KINDS.items() if c == "step")
 

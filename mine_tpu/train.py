@@ -12,8 +12,17 @@ import argparse
 import os
 
 
-def build_dataset(cfg, split: str, global_batch: int):
-    """Dataset factory (reference train.py:72-164 get_dataset)."""
+def build_dataset(cfg, split: str, global_batch: int,
+                  host_slice: tuple[int, int] | None = None):
+    """Dataset factory (reference train.py:72-164 get_dataset).
+
+    `host_slice` is (start, count) of the global batch THIS host should
+    materialize (Trainer.host_batch_slice, off the `^batch/` partition
+    row). Loaders that honor it build only their rows — each host's IO
+    drops to 1/N of the global batch (the DistributedSampler role).
+    Loaders without support ignore it and return global batches; staging
+    slices those down on multi-process runs (numerically identical,
+    parallel/mesh.py shard_batch — just wasteful host IO)."""
     name = cfg.data.name
     if name == "synthetic":
         # data.num_tgt_views is a no-op here by design: every synthetic batch
@@ -26,6 +35,7 @@ def build_dataset(cfg, split: str, global_batch: int):
             steps_per_epoch=12 if split == "train" else 2,
             n_points=cfg.data.visible_point_count,
             seed=cfg.training.seed + (0 if split == "train" else 10_000),
+            host_slice=host_slice,
         )
     if name in ("llff", "nocs_llff"):
         from mine_tpu.data.llff import LLFFDataset
@@ -78,7 +88,14 @@ def main(argv: list[str] | None = None) -> None:
     cfg = load_config(default, *args.config, overrides=args.extra_config)
 
     trainer = Trainer(cfg, args.workspace, profile_steps=args.profile_steps)
-    train_ds = build_dataset(cfg, "train", trainer.global_batch)
+    # the train loader materializes only this host's batch rows (per-host
+    # data sharding); eval keeps global batches (the compat path — staging
+    # slices them, run_evaluation's weighted meters need every host to see
+    # the same metric stream anyway)
+    train_ds = build_dataset(
+        cfg, "train", trainer.global_batch,
+        host_slice=trainer.host_batch_slice(),
+    )
     val_ds = build_dataset(cfg, "val", trainer.global_batch)
     trainer.fit(train_ds, val_ds)
 

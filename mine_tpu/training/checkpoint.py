@@ -77,19 +77,57 @@ def checkpoint_manager(
     A URL-scheme workspace (`gs://bucket/run`, `file://…`) passes through
     un-mangled, so orbax writes checkpoints durably to object storage — the
     analog of the reference's HDFS upload (synthesis_task.py:654-658,
-    utils.py:20-37 `run_shell_cmd` hadoop put), minus the rank-0 shell-out:
-    orbax coordinates the multi-host write itself.
+    utils.py:20-37 `run_shell_cmd` hadoop put), minus the rank-0 shell-out.
+
+    Multi-process runs: every save path in this repo is gather-on-save —
+    host numpy arrays identical on every process — so orbax's collective
+    multi-host write protocol (which shards writes by process and
+    barriers all of them) is exactly wrong for it: N processes would race
+    identical bytes into one tmp directory (observed: rename ENOENT
+    corruption). The manager is therefore scoped PROCESS-LOCAL
+    (`active_processes={self}`: barriers become singleton no-ops) and
+    `save()` below writes from process 0 alone; reads (restore /
+    latest_step / all_steps) stay safe from every process because they
+    only see atomically-committed step directories. NOTE: this is the
+    replicated/gathered-checkpoint contract — saving layout-SHARDED
+    global arrays across hosts would need the collective protocol back
+    (README Multi-host).
     """
+    import jax
+
     path = checkpoint_path(workspace)
+    create = True
+    kwargs = {}
+    if jax.process_count() > 1:
+        me = jax.process_index()
+        kwargs["multiprocessing_options"] = ocp.options.MultiprocessingOptions(
+            primary_host=me, active_processes={me},
+            barrier_sync_key_prefix=f"mine_tpu_p{me}",
+        )
+        # orbax refuses create=True under active_processes; local paths we
+        # can make ourselves (exist_ok absorbs the N-process race), remote
+        # schemes rely on the object store's implicit-prefix semantics
+        create = False
+        if "://" not in path:
+            os.makedirs(path, exist_ok=True)
     options = ocp.CheckpointManagerOptions(
         max_to_keep=max_to_keep,
         keep_period=keep_period,
-        create=True,
+        create=create,
+        **kwargs,
     )
     return ocp.CheckpointManager(path, options=options)
 
 
 def save(manager: ocp.CheckpointManager, state: Any, step: int) -> None:
+    """Write one gathered (host-array) checkpoint. Multi-process: process
+    0 writes alone — the state is replicated host data on every process
+    (see checkpoint_manager); peers return immediately and rely on the
+    atomic commit for read-side consistency."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
     manager.save(step, args=ocp.args.StandardSave(state))
 
 
@@ -180,7 +218,13 @@ def _last_good_path(workspace: str) -> str:
 def mark_last_good(workspace: str, step: int) -> None:
     """Atomically record `step` as the newest checkpoint known healthy
     (saved while the training sentinel saw only finite losses). Distinct
-    from `latest_step()`: the newest checkpoint may postdate a trip."""
+    from `latest_step()`: the newest checkpoint may postdate a trip.
+    Multi-process: the pointer is global state like the checkpoint itself
+    — process 0 writes it (same gating as save())."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
     path = _last_good_path(workspace)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"

@@ -47,7 +47,7 @@ from mine_tpu.parallel import (
     data_replica_count,
     distribute_state,
     fsdp_enabled,
-    init_multihost,
+    host_batch_slice,
     make_mesh,
     make_parallel_eval_step,
     make_parallel_train_step,
@@ -58,6 +58,7 @@ from mine_tpu.parallel import (
 )
 from mine_tpu.parallel import rules as rules_mod
 from mine_tpu.resilience import (
+    MultihostSurvival,
     PreemptedError,
     PreemptionGuard,
     SentinelAbort,
@@ -65,6 +66,7 @@ from mine_tpu.resilience import (
     TrainingSentinel,
     chaos,
 )
+from mine_tpu.resilience import multihost as multihost_mod
 from mine_tpu.training import checkpoint as ckpt
 from mine_tpu.training.optimizer import learning_rates, make_optimizer
 from mine_tpu.training.step import build_model, init_state
@@ -91,6 +93,7 @@ def staged_batches(
     retries: int = 0,
     on_retry: Callable[[int, BaseException], None] | None = None,
     rules: tuple | None = None,
+    global_rows: int | None = None,
 ) -> Iterable[dict]:
     """Two-stage pipeline overlap (SURVEY.md §7.4.7; the reference builds
     every batch synchronously in the step loop, nerf_dataset.py:199-236):
@@ -101,7 +104,12 @@ def staged_batches(
     `retries` (data.loader_retries) bounds transient-error retries of the
     host stage (exponential backoff + jitter, data/pipeline.py), which also
     hosts the `loader_raise` chaos seam; the device-staging stage never
-    retries (a failed device transfer is not a loader hiccup)."""
+    retries (a failed device transfer is not a loader hiccup).
+
+    `global_rows` is the GLOBAL batch size, threaded into shard_batch for
+    multi-process runs: host batches may then be either this host's local
+    slice (per-host loaders) or the full global batch (compat loaders —
+    sliced down at staging, numerically identical)."""
     host = prefetch(
         epoch_iter, max(num_workers - 2, 0),
         retries=retries, on_retry=on_retry, fault_seam="loader_raise",
@@ -111,7 +119,7 @@ def staged_batches(
     # step's table-derived in_shardings expect them (None = default table)
     return prefetch(
         host, min(num_workers, 2),
-        transfer=lambda b: shard_batch(mesh, b, rules),
+        transfer=lambda b: shard_batch(mesh, b, rules, global_rows=global_rows),
     )
 
 
@@ -150,7 +158,15 @@ class TrainObsMetrics:
         self.data_retries = r.counter(
             "mine_train_data_retries_total",
             "host batches retried after transient loader/staging errors "
-            "(data.loader_retries)",
+            "(data.loader_retries; labeled by process_index so a pod-scale "
+            "flaky mount is attributable to a host)",
+        )
+        self.data_host_bytes = r.counter(
+            "mine_train_data_host_bytes_total",
+            "bytes of host batch data THIS process materialized (labeled "
+            "by process_index). Under per-host data sharding each of N "
+            "hosts counts ~1/N of the global batch bytes; a host counting "
+            "the full product is on the global-load-then-slice compat path",
         )
         self.accum_steps = r.gauge(
             "mine_train_accum_steps",
@@ -199,7 +215,14 @@ class Trainer:
     """Owns mesh, model, state, and the jitted steps; `fit` runs epochs."""
 
     def __init__(self, cfg: Config, workspace: str, profile_steps: int = 0):
-        init_multihost()
+        # multi-host bring-up FIRST (must precede any backend touch): the
+        # retrying wrapper around init_multihost — a no-op on single-host
+        # runs, bounded-backoff retry for a coordinator that is not up yet
+        # (resilience/multihost.py bring_up)
+        multihost_mod.bring_up(
+            attempts=cfg.resilience.multihost_bringup_attempts,
+            backoff_s=cfg.resilience.multihost_bringup_backoff_s,
+        )
         self.cfg = cfg
         self.workspace = workspace
         # URL-scheme workspaces (gs://…) are valid for checkpoints (orbax
@@ -249,6 +272,13 @@ class Trainer:
             cfg.resilience, self.obs_metrics.registry, self.logger,
             flight=self.flight,
         )
+        # multi-host survival (None single-process): heartbeat exchange on
+        # the shared sidecar + the cross-host stall watchdog that turns a
+        # dead/wedged peer into a bounded named abort (resilience/multihost)
+        self.multihost = MultihostSurvival.maybe_create(
+            cfg, self.local_dir, flight=self.flight, logger=self.logger,
+        )
+        self._host_bytes = 0  # host-materialized batch bytes, this process
         self.model = build_model(cfg, **model_axes(self.mesh))
         # effective batch PER UPDATE. Accumulation splits each device's
         # batch into accum_steps micro-batches inside the step; it never
@@ -292,16 +322,59 @@ class Trainer:
                     workspace, self.local_dir,
                 )
 
+    def host_batch_slice(self) -> tuple[int, int]:
+        """(start, count) of the global batch THIS host's loader should
+        materialize (parallel/mesh.py host_batch_slice off the `^batch/`
+        partition row). (0, global_batch) single-process."""
+        return host_batch_slice(self.mesh, self.global_batch, self._rules)
+
+    def _count_host_bytes(self, epoch_iter: Iterable[dict]) -> Iterable[dict]:
+        """Meter the host-materialized batch bytes (the per-host
+        data-sharding measurement: with N hosts each should count ~1/N of
+        the global batch bytes per step). A delegating iterator — not a
+        generator — so the source's `retry_safe_iter` contract survives:
+        a raise does not close anything, and a retried `__next__` reaches
+        the source's own `__next__` (data/pipeline.py pull retry)."""
+        trainer = self
+        pidx = str(jax.process_index())
+
+        class _Counting:
+            retry_safe_iter = getattr(epoch_iter, "retry_safe_iter", False)
+
+            def __init__(self):
+                self._src = iter(epoch_iter)
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                batch = next(self._src)
+                n = sum(
+                    np.asarray(leaf).nbytes
+                    for leaf in jax.tree.leaves(batch)
+                )
+                trainer._host_bytes += n
+                trainer.obs_metrics.data_host_bytes.inc(
+                    n, process_index=pidx
+                )
+                return batch
+
+        return _Counting()
+
     def _staged_batches(self, epoch_iter: Iterable[dict]) -> Iterable[dict]:
         return staged_batches(
-            self.mesh, self.cfg.data.num_workers, epoch_iter,
+            self.mesh, self.cfg.data.num_workers,
+            self._count_host_bytes(epoch_iter),
             retries=self.cfg.data.loader_retries,
             on_retry=self._on_loader_retry,
             rules=self._rules,
+            global_rows=self.global_batch,
         )
 
     def _on_loader_retry(self, attempt: int, exc: BaseException) -> None:
-        self.obs_metrics.data_retries.inc()
+        self.obs_metrics.data_retries.inc(
+            process_index=str(jax.process_index())
+        )
         self.logger.warning(
             "transient loader error (retry %d): %s: %s",
             attempt, type(exc).__name__, exc,
@@ -326,8 +399,24 @@ class Trainer:
             cfg, self.model, tx, jax.random.PRNGKey(cfg.training.seed),
             load_pretrained=not resuming,
         )
-        # auto-resume from this workspace; else warm-start from a path
-        state, start_step = ckpt.restore(manager, state)
+        # auto-resume from this workspace; else warm-start from a path.
+        # training.resume_from=last_good trusts only the sentinel-vetted
+        # pointer — the elastic-restart stance: after a host loss the
+        # NEWEST step may be a partially-committed save from the dying run
+        if cfg.training.resume_from not in ("latest", "last_good"):
+            raise ValueError(
+                f"training.resume_from={cfg.training.resume_from!r} "
+                "(known: latest, last_good)"
+            )
+        if cfg.training.resume_from == "last_good":
+            try:
+                state, start_step = ckpt.restore_last_good(
+                    manager, state, self.workspace
+                )
+            except FileNotFoundError:
+                start_step = 0  # fresh workspace: nothing to trust yet
+        else:
+            state, start_step = ckpt.restore(manager, state)
         warm_path = cfg.training.pretrained_checkpoint_path
         if start_step == 0 and warm_path:
             if warm_path.endswith(".npz"):
@@ -392,6 +481,11 @@ class Trainer:
 
         if self.flight is not None:
             self.flight.start()
+        if self.multihost is not None:
+            # heartbeats begin at the first completed log interval (the
+            # initial compile must not trip the window); the watchdog
+            # judges only files that exist (resilience/multihost.py)
+            self.multihost.start()
         # preemption guard AFTER the flight recorder, so its SIGTERM handler
         # chains: atomic save -> flight dump -> re-delivered termination
         guard: PreemptionGuard | None = None
@@ -400,28 +494,41 @@ class Trainer:
             guard.install()
         self._manager = manager
         self._live_state = state  # emergency-save target from the first step on
+        fit_ok = False
         try:
             last_val = self._fit_epochs(
                 cfg, train_ds, val_ds, state, train_step, eval_step,
                 manager, meters, start_step,
             )
+            fit_ok = True
         except (KeyboardInterrupt, Exception):
             # failure containment (SURVEY.md §5.3 — the reference has none):
             # whatever just died, persist the last completed step so the next
             # run auto-resumes instead of losing the epoch. The emergency save
             # itself may fail (e.g. the device poisoned the state arrays) —
             # never let that mask the original error.
+            if self.multihost is not None:
+                # a multi-process failure path can block on dead peers at
+                # every remaining step (the emergency device_get, the jax
+                # shutdown barrier) — bound it NOW, before attempting any
+                # of them (resilience/multihost.py arm_failsafe)
+                self.multihost.arm_failsafe()
             if self.flight is not None:
                 self.flight.dump("train_exception")
             try:
-                host_state = jax.device_get(self._live_state)
-                step_now = int(host_state.step)
-                self.logger.exception(
-                    "training interrupted at step %d; writing emergency "
-                    "checkpoint", step_now,
-                )
-                ckpt.save(manager, host_state, step_now)
-                ckpt.wait_until_finished(manager)
+                # multi-process: peers skip — only process 0's write lands
+                # (checkpoint.py save), and a peer's device_get here could
+                # block on a DEAD peer's unfinished collective (the
+                # failsafe above bounds process 0's attempt too)
+                if jax.process_index() == 0:
+                    host_state = jax.device_get(self._live_state)
+                    step_now = int(host_state.step)
+                    self.logger.exception(
+                        "training interrupted at step %d; writing emergency "
+                        "checkpoint", step_now,
+                    )
+                    ckpt.save(manager, host_state, step_now)
+                    ckpt.wait_until_finished(manager)
             except BaseException:  # noqa: BLE001 - incl. a second Ctrl+C
                 self.logger.exception("emergency checkpoint failed")
             raise
@@ -430,6 +537,14 @@ class Trainer:
                 guard.uninstall()
             self._live_state = None  # don't pin the state in HBM after fit
             self._manager = None
+            if self.multihost is not None:
+                # done=True ONLY on clean completion: it exempts this host
+                # from peers' staleness judgment, and a crashing host's
+                # silence is exactly what peers must detect
+                self.multihost.stop(
+                    done=fit_ok, step=self._progress.get("global_step"),
+                    data_bytes=self._host_bytes,
+                )
             if self.flight is not None:
                 self.flight.stop()
             self._export_host_trace()
@@ -441,15 +556,25 @@ class Trainer:
                 self.logger.exception("checkpoint drain failed")
         return last_val
 
+    def _host_state_for_save(self, state):
+        """device_get for a checkpoint write — on multi-process runs only
+        process 0 writes (training/checkpoint.py save), so peers skip the
+        full-state D2H gather entirely (N-1 wasted state-sized transfers
+        per checkpoint interval otherwise)."""
+        return jax.device_get(state) if jax.process_index() == 0 else None
+
     def _preempt_save(self, reason: str) -> None:
         """Out-of-band atomic checkpoint (resilience/preempt.py): runs in
         the SIGTERM/SIGUSR2 handler on the main thread, i.e. between
         bytecodes of the step loop — `_live_state` is always the last
         COMPLETED step. Skips steps already on disk, waits for the write,
-        and advances the last-good pointer."""
+        and advances the last-good pointer. Multi-process: only process 0
+        writes, so peers return outright."""
         state, manager = self._live_state, self._manager
         if state is None or manager is None:
             return  # not inside fit()
+        if jax.process_index() != 0:
+            return  # the save and the pointer are process-0 writes
         host_state = jax.device_get(state)
         step = int(host_state.step)
         self.logger.warning(
@@ -775,6 +900,26 @@ class Trainer:
                         os.kill(os.getpid(), signal.SIGUSR2)
                     if chaos_sched.should("sigterm", at=global_step):
                         os.kill(os.getpid(), signal.SIGTERM)
+                    if chaos_sched.should("host_kill", at=global_step):
+                        # a host dying: SIGKILL — no dump, no save, no
+                        # goodbye. Survivors' watchdogs are the proof
+                        # target (resilience/multihost.py).
+                        self.logger.warning(
+                            "chaos: host_kill after step %d", global_step
+                        )
+                        os.kill(os.getpid(), signal.SIGKILL)
+                    if chaos_sched.should("host_stall", at=global_step):
+                        # a wedged host (hung collective / dead ICI link):
+                        # stop making progress but stay alive. Every
+                        # host's watchdog — including this one's own —
+                        # must abort boundedly (EXIT_HOST_STALL).
+                        self.logger.warning(
+                            "chaos: host_stall after step %d — sleeping "
+                            "until the watchdog aborts this process",
+                            global_step,
+                        )
+                        while True:
+                            time.sleep(3600.0)
                 if (self.profile_steps
                         and global_step == profile_at + self.profile_steps):
                     jax.block_until_ready(loss_dict["loss"])
@@ -836,6 +981,13 @@ class Trainer:
                             # live HBM gauges + the counter-event curve the
                             # host-trace export draws (obs/memlog.py)
                             self.memlog.sample(step=global_step)
+                        if self.multihost is not None:
+                            # cross-host heartbeat, piggybacked on the sync
+                            # this block already paid for: one tiny atomic
+                            # file write per log interval
+                            self.multihost.beat(
+                                global_step, data_bytes=self._host_bytes
+                            )
                     if tracer.enabled:
                         # AFTER the log span closes, so this interval's own
                         # sync/log phases are in the summary it publishes
@@ -851,7 +1003,10 @@ class Trainer:
                     # suspect step as the new last-good
                     self.sentinel.flush(global_step)
                     with tracer.span("ckpt", cat="train", step=global_step):
-                        ckpt.save(manager, jax.device_get(state), global_step)
+                        ckpt.save(
+                            manager, self._host_state_for_save(state),
+                            global_step,
+                        )
                     ckpt.mark_last_good(self.workspace, global_step)
                     self.logger.info("checkpoint saved @ step %d", global_step)
 
@@ -879,7 +1034,9 @@ class Trainer:
             # an exact-resume restart (or a preemption save that landed on
             # the final step) may already hold this step on disk
             if global_step not in {int(s) for s in manager.all_steps()}:
-                ckpt.save(manager, jax.device_get(state), global_step)
+                ckpt.save(
+                    manager, self._host_state_for_save(state), global_step
+                )
             ckpt.wait_until_finished(manager)
             ckpt.mark_last_good(self.workspace, global_step)
         self.writer.flush()
@@ -907,6 +1064,10 @@ def run_evaluation(
     for i, batch in enumerate(staged_batches(
         mesh, cfg.data.num_workers, val_ds.epoch(0),
         rules=rules_mod.partition_rules(cfg),
+        # multi-process: val loaders are global-batch (the compat path —
+        # shard_batch slices each host's rows out); the global row count
+        # disambiguates local-slice from global input
+        global_rows=cfg.data.per_gpu_batch_size * data_replica_count(mesh),
     )):
         loss_dict, viz = eval_step(state, batch, jax.random.fold_in(key, i))
         # metric values are weighted means over GENUINE examples only

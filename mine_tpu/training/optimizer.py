@@ -25,13 +25,29 @@ def _multistep(base_lr: float, decay_steps, gamma: float, steps_per_epoch: int):
 
 
 def make_optimizer(cfg: Config, steps_per_epoch: int) -> optax.GradientTransformation:
+    # training.optimizer: "adam" is reference parity; "sgd" keeps the update
+    # linear in the gradient — the cross-topology parity methodology (mesh
+    # shapes / elastic host counts only fp-epsilon-match under it, because
+    # Adam's first step is sign(grad)*lr and amplifies reassociation noise
+    # on zero-effective-grad leaves into full ±lr flips; PARITY.md)
+    if cfg.training.optimizer not in ("adam", "sgd"):
+        raise ValueError(
+            f"training.optimizer={cfg.training.optimizer!r} (known: adam, sgd)"
+        )
+
     def group(base_lr: float) -> optax.GradientTransformation:
+        scale = _multistep(
+            base_lr, cfg.lr.decay_steps, cfg.lr.decay_gamma, steps_per_epoch
+        )
+        if cfg.training.optimizer == "sgd":
+            return optax.chain(
+                optax.add_decayed_weights(cfg.lr.weight_decay),
+                optax.scale_by_learning_rate(scale),
+            )
         return optax.chain(
             optax.add_decayed_weights(cfg.lr.weight_decay),
             optax.scale_by_adam(),  # b1/b2/eps defaults match torch Adam
-            optax.scale_by_learning_rate(
-                _multistep(base_lr, cfg.lr.decay_steps, cfg.lr.decay_gamma, steps_per_epoch)
-            ),
+            optax.scale_by_learning_rate(scale),
         )
 
     return optax.multi_transform(

@@ -32,6 +32,7 @@ def force_cpu_devices(
     n_devices: int,
     compilation_cache: bool = False,
     fast_compile: bool = False,
+    verify: bool = True,
 ) -> None:
     """Force an n-device virtual CPU backend before any JAX backend touch.
 
@@ -65,6 +66,10 @@ def force_cpu_devices(
     jax.config.update("jax_platforms", "cpu")
     if compilation_cache:
         enable_persistent_compile_cache()
+    if not verify:
+        # env + config are set; the caller (a pending multi-host bring-up)
+        # cannot afford the jax.devices() probe — it IS a backend touch
+        return
     devices = jax.devices()
     if len(devices) != n_devices or devices[0].platform != "cpu":
         raise RuntimeError(
@@ -157,4 +162,9 @@ def honor_jax_platforms() -> None:
         if f.startswith(VIRTUAL_DEVICE_FLAG + "=")
     ]
     n = int(preset[-1].split("=")[1]) if preset else 1
-    force_cpu_devices(n)
+    # A pending multi-host bring-up (parallel/mesh.py init_multihost reads
+    # $MINE_TPU_MULTIHOST) forbids touching the backend here:
+    # jax.distributed.initialize() only works on an untouched backend, and
+    # the verification probe below IS a backend touch. Set the flags, skip
+    # the probe — bring-up itself fails loudly if something pre-initialized.
+    force_cpu_devices(n, verify=not os.environ.get("MINE_TPU_MULTIHOST"))

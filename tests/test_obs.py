@@ -117,10 +117,11 @@ def test_disabled_tracer_records_nothing_and_is_noop_cheap():
 
 
 def _flight_files(dump_dir: str) -> list[str]:
-    return sorted(
-        os.path.join(dump_dir, d) for d in os.listdir(dump_dir)
-        if d.startswith("flight_")
-    )
+    # dumps land under a per-process subdir (`p<idx>-<pid>/flight_...`),
+    # so N processes sharing a sidecar never interleave writes
+    import glob
+
+    return sorted(glob.glob(os.path.join(dump_dir, "*", "flight_*")))
 
 
 def test_flight_recorder_dumps_on_sigusr1(tmp_path):

@@ -233,7 +233,11 @@ def test_prefetch_retries_transient_then_succeeds():
     assert out == [10, 20, 30]
     assert retried == [1, 2]  # two backoff retries, then clean
 
-    # exhaustion re-raises the transient error at the consumer
+    # exhaustion raises the NAMED terminal error, chaining the transient
+    # cause (LoaderRetriesExhausted — tests/test_multihost.py pins the
+    # attempt accounting; raw errors still relay when retries=0)
+    from mine_tpu.data.pipeline import LoaderRetriesExhausted
+
     always = prefetch(
         iter([1]), depth=1,
         transfer=lambda item: (_ for _ in ()).throw(
@@ -241,7 +245,7 @@ def test_prefetch_retries_transient_then_succeeds():
         ),
         retries=2, retry_base_delay_s=0.001,
     )
-    with pytest.raises(TransientLoaderError, match="dead disk"):
+    with pytest.raises(LoaderRetriesExhausted, match="dead disk"):
         list(always)
 
     # non-transient errors fail fast: no retry, first raise relays

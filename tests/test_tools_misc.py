@@ -77,6 +77,11 @@ def test_multihost_env_flag_triggers_auto_init(dist_calls, monkeypatch):
     monkeypatch.setenv("MINE_TPU_MULTIHOST", "1")
     init_multihost()
     assert dist_calls["n"] == 1 and dist_calls["kwargs"] == {}
+    # ANY non-address truthy spelling keeps the auto-detection contract —
+    # only a ':'-shaped value is dialed as a coordinator address
+    monkeypatch.setenv("MINE_TPU_MULTIHOST", "yes")
+    init_multihost()
+    assert dist_calls["n"] == 2 and dist_calls["kwargs"] == {}
 
 
 def test_multihost_coordinator_passed_through(dist_calls):
@@ -110,6 +115,57 @@ def test_multihost_real_failure_with_coordinator_raises(dist_calls):
     dist_calls["raise"] = RuntimeError("connection refused")
     with pytest.raises(RuntimeError, match="connection refused"):
         init_multihost(coordinator="10.0.0.1:1234")
+
+
+def test_multihost_env_carries_coordinator_and_topology(dist_calls,
+                                                        monkeypatch):
+    """The harness channel (tools/multihost_harness.py): the env var
+    doubles as the coordinator address, and NPROCS/PROC_ID ride along —
+    the same manual-topology kwargs a pod launcher would pass."""
+    monkeypatch.setenv("MINE_TPU_MULTIHOST", "127.0.0.1:9999")
+    monkeypatch.setenv("MINE_TPU_MULTIHOST_NPROCS", "4")
+    monkeypatch.setenv("MINE_TPU_MULTIHOST_PROC_ID", "2")
+    init_multihost()
+    assert dist_calls["kwargs"] == {
+        "coordinator_address": "127.0.0.1:9999",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    monkeypatch.delenv("MINE_TPU_MULTIHOST_NPROCS")
+    monkeypatch.delenv("MINE_TPU_MULTIHOST_PROC_ID")
+
+
+# ------------------------------------------------------ perf-ledger hygiene
+
+
+def test_no_ledger_writer_resolves_to_a_tracked_path(monkeypatch):
+    """The repo-root `perf_ledger.jsonl` debris predating PR 6's gitignore
+    is gone, and every path the ledger writers can resolve to is ignored —
+    a bench run must never leave a trackable measurement file for `git add
+    .` to scoop up (machine-local rows poison other machines' baselines)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tracked = subprocess.run(
+        ["git", "ls-files"], cwd=repo, capture_output=True, text=True,
+    )
+    if tracked.returncode != 0:
+        pytest.skip("not a git checkout")
+    assert "perf_ledger.jsonl" not in tracked.stdout.splitlines()
+    assert not os.path.exists(os.path.join(repo, "perf_ledger.jsonl"))
+
+    from mine_tpu.obs import ledger
+
+    # the writers' default resolution (env unset) must be a gitignored path
+    monkeypatch.delenv(ledger.LEDGER_ENV, raising=False)
+    path = ledger.ledger_path()
+    assert path is not None
+    rel = os.path.relpath(os.path.join(repo, path), repo)
+    ignored = subprocess.run(
+        ["git", "check-ignore", rel], cwd=repo,
+        capture_output=True, text=True,
+    )
+    assert ignored.returncode == 0, (
+        f"default ledger path {rel!r} is not gitignored"
+    )
 
 
 def test_profile_summary_top_op_table(tmp_path):

@@ -39,8 +39,40 @@ router, mine_tpu/serving/fleet.py — control-plane truth needs no XLA):
   REJECTED with a named error + counter, the old generation still serves
   (follow-up requests 200), and nothing 5xxs.
 
+Multihost half (REAL jax.distributed multi-process training via
+tools/multihost_harness.py — N subprocesses on one box, the code path a
+pod runs; slow, run explicitly or via --half all):
+  kill run    4 hosts, global batch 12 (3/host, each host's loader
+              materializing ONLY its `^batch/` slice), host 0 bring-up
+              retried through `coord_down@init=1`; `host_kill@step=3`
+              SIGKILLs host 1 mid-run. Every survivor writes a flight
+              dump into its OWN per-process subdir and exits with the
+              NAMED abort (resilience/multihost.py EXIT_HOST_STALL)
+              inside the watchdog window — no indefinite collective
+              hang; last_good stays at the last vetted checkpoint, and
+              every host's heartbeat proves it materialized exactly 1/4
+              of the global batch bytes.
+  elastic     the same workspace restarts at 3 hosts (4/host — same
+              global batch) from `last_good`. The fp-epsilon gate is the
+              from-same-checkpoint control (PR 7 single-step
+              methodology): the first post-restart step at 3 hosts vs
+              the SAME step at the original 4 hosts — loss rel <= 2e-4,
+              per-leaf update-norm diffs <= 5% (SGD +
+              mpi.fix_disparity; measured ~3e-6 / ~2e-4). The completed
+              elastic run then tracks an uninterrupted
+              3-host-from-scratch reference's final loss (<= 10%
+              trajectory sanity — per-step fp noise is amplified by the
+              loss surface's ReLU/mask discontinuities over further
+              steps, which no resume mechanism can bound; PARITY.md
+              5.12). The layout-free gathered checkpoints make
+              topology-changing restarts not just possible but PROVEN.
+  bitwise     2 hosts interrupted by `preempt_exit@step=3` -> restart at
+              the SAME topology -> final params BITWISE equal to an
+              uninterrupted 2-host reference (PR 4's islice-resume proof,
+              extended across process boundaries).
+
 Usage:
-  python tools/chaos_drill.py [--half training|serving|fleet|all]
+  python tools/chaos_drill.py [--half training|serving|fleet|multihost|all]
                               [--workdir DIR] [--no-exact] [--steps N]
 """
 
@@ -578,10 +610,301 @@ def fleet_half(timeout_s: float) -> dict:
     return result
 
 
+# tiny config for the REAL multi-process runs (1 CPU device per host).
+# SGD: cross-topology (4-host -> 3-host) parity only holds fp-epsilon under
+# an update linear in the gradient (PR 7 methodology; training.optimizer).
+# resume_from=last_good: an elastic restart must trust only the vetted
+# pointer — the dying run's newest save may be partial or unvetted.
+MULTIHOST_OVERRIDES = {
+    "data.name": "synthetic",
+    "data.img_h": 128, "data.img_w": 128,
+    "data.num_workers": 0,
+    "model.num_layers": 18, "model.dtype": "float32",
+    "model.imagenet_pretrained": False,
+    "mpi.num_bins_coarse": 2,
+    # fixed disparities: the stratified sampler's per-device RNG folds the
+    # mesh axis index, so a 4-host and a 3-host run draw DIFFERENT
+    # samples by design — cross-topology parity is only defined with the
+    # sampler pinned (PR 7's mesh-parity methodology, PARITY.md)
+    "mpi.fix_disparity": True,
+    "training.epochs": 1,
+    "training.log_interval": 1,
+    "training.checkpoint_interval": 2,
+    "training.optimizer": "sgd",
+    "training.resume_from": "last_good",
+    "obs.enabled": True,
+    "resilience.multihost_watchdog_s": 20.0,
+}
+MULTIHOST_GLOBAL_BATCH = 12  # divisible by both 4 and 3 hosts
+
+
+def _global_batch_bytes() -> int:
+    """Host bytes of one full global batch (the 1/N denominator)."""
+    from mine_tpu.data import make_synthetic_batch
+
+    batch = make_synthetic_batch(
+        MULTIHOST_GLOBAL_BATCH, MULTIHOST_OVERRIDES["data.img_h"],
+        MULTIHOST_OVERRIDES["data.img_w"], n_points=32, seed=0,
+    )
+    batch.pop("src_depth")
+    return sum(v.nbytes for v in batch.values())
+
+
+def _final_loss(log_text: str, step: int) -> float | None:
+    """The logged loss of `global_step=step`, last occurrence (a resumed
+    workspace's train.log carries every run appended)."""
+    import re
+
+    hits = re.findall(rf"global_step={step} loss=([0-9.]+)", log_text)
+    return float(hits[-1]) if hits else None
+
+
+def _param_parity(ws_a: str, ws_b: str, final_step: int,
+                  base_step: int) -> dict:
+    """PR 7's update-norm methodology between two workspaces: per-leaf
+    final-param diffs measured against each run's own update magnitude
+    (final - base checkpoint); zero-effective-gradient leaves (norms
+    < 1e-3) are the known exclusion."""
+    import numpy as np
+
+    import jax
+
+    worst = 0.0
+    compared = 0
+    pa, pb = _params_of(ws_a, final_step), _params_of(ws_b, final_step)
+    ba, bb = _params_of(ws_a, base_step), _params_of(ws_b, base_step)
+    for (path, a), b, a0, b0 in zip(
+        jax.tree_util.tree_leaves_with_path(pa), jax.tree.leaves(pb),
+        jax.tree.leaves(ba), jax.tree.leaves(bb),
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        ua = float(np.linalg.norm(a - np.asarray(a0)))
+        ub = float(np.linalg.norm(b - np.asarray(b0)))
+        if max(ua, ub) < 1e-3:
+            continue
+        compared += 1
+        worst = max(worst, float(np.linalg.norm(a - b)) / max(ua, ub))
+    return {"worst_update_rel": worst, "leaves_compared": compared}
+
+
+def multihost_half(workdir: str, timeout_s: float) -> dict:
+    """Kill / elastic-resume / bitwise-resume against REAL multi-process
+    training (module docstring)."""
+    import numpy as np
+
+    import jax
+
+    from mine_tpu.resilience.multihost import EXIT_HOST_STALL
+    from mine_tpu.training import checkpoint as ckpt
+    from tools import multihost_harness as mh
+
+    result: dict = {}
+
+    # ---- phase A: kill host 1 of 4 mid-run ------------------------------
+    ws = os.path.join(workdir, "mh_ws")
+    kill = mh.launch(
+        ws, n_hosts=4, steps=6,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 3}),
+        faults={1: "host_kill@step=3", 0: "coord_down@init=1"},
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_kill"),
+    )
+    result["kill_returncodes"] = kill.returncodes
+    result["kill_wall_s"] = round(kill.wall_s, 1)
+    result["victim_sigkilled"] = kill.hosts[1].died_by_signal == signal.SIGKILL
+    survivors = [kill.hosts[i] for i in (0, 2, 3)]
+    result["survivors_named_abort"] = all(
+        h.returncode == EXIT_HOST_STALL for h in survivors
+    )
+    result["no_survivor_hung"] = not any(h.timed_out for h in survivors)
+    result["bringup_retry_logged"] = (
+        "bring-up attempt 1" in kill.hosts[0].log
+    )
+    markers = kill.abort_markers()
+    result["abort_markers"] = sorted(markers)
+    dumps = kill.flight_dump_dirs()
+    result["survivor_flight_dumps"] = {
+        str(i): len(dumps.get(i, [])) for i in (0, 2, 3)
+    }
+    result["last_good_after_kill"] = ckpt.last_good_step(ws)
+    # per-host data sharding: every host's heartbeat counts exactly
+    # step x (global batch bytes / 4) of host-materialized loader bytes
+    per_host_step_bytes = _global_batch_bytes() // 4
+    beats = kill.heartbeats()
+    result["host_bytes_quarter"] = bool(beats) and all(
+        b.get("data_bytes") == per_host_step_bytes * b.get("step", 0)
+        for b in beats.values()
+    )
+
+    ok_kill = (
+        result["victim_sigkilled"]
+        and result["survivors_named_abort"]
+        and result["no_survivor_hung"]
+        and result["bringup_retry_logged"]
+        and set(markers) == {0, 2, 3}
+        and all(v >= 1 for v in result["survivor_flight_dumps"].values())
+        and result["last_good_after_kill"] == 2
+        and result["host_bytes_quarter"]
+    )
+    result["kill_ok"] = ok_kill
+
+    # ---- phase B: elastic restart at N-1 hosts --------------------------
+    # The fp-epsilon gate is the FROM-SAME-CHECKPOINT control (the PR 7
+    # single-step methodology): restore the 4-host run's last_good into
+    # the 3-host topology AND into the original 4-host topology, take the
+    # same step on the same global batch, and compare the updates — this
+    # isolates exactly the topology change. End-to-end trajectories after
+    # several more steps are gated as a SANITY bound only: per-step
+    # fp-reassociation noise (~1e-4 update rel, measured) is amplified by
+    # the loss surface's discontinuities (ReLU/mask flips in a
+    # from-random-init net), which no resume mechanism can bound.
+    import shutil
+
+    def _clear_heartbeats(workspace: str) -> None:
+        # hot-relaunching a JUST-crashed workspace: the kill run's abort
+        # markers / dead-host beats can still be younger than the start()
+        # sweep's age cutoff (resilience/multihost.py _CLEANUP_MIN_AGE_S)
+        # on a fast box, and copytree preserves mtimes — clear them so a
+        # fresh run can never judge the previous incarnation's evidence
+        shutil.rmtree(os.path.join(workspace, "heartbeats"),
+                      ignore_errors=True)
+
+    ws_cont4 = os.path.join(workdir, "mh_ws_cont4")
+    shutil.copytree(ws, ws_cont4)
+    _clear_heartbeats(ws)
+    _clear_heartbeats(ws_cont4)
+    elastic_step = mh.launch(
+        ws, n_hosts=3, steps=3,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 4}),
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_elastic1"),
+    )
+    cont4_step = mh.launch(
+        ws_cont4, n_hosts=4, steps=3,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 3}),
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_cont4"),
+    )
+    result["elastic_step_returncodes"] = elastic_step.returncodes
+    result["cont4_step_returncodes"] = cont4_step.returncodes
+    result["elastic_resumed_logged"] = (
+        "resumed from step 2" in elastic_step.hosts[0].log
+    )
+    ok_primitive = (
+        all(rc == 0 for rc in elastic_step.returncodes)
+        and all(rc == 0 for rc in cont4_step.returncodes)
+        and result["elastic_resumed_logged"]
+    )
+    if ok_primitive:
+        loss_e = _final_loss(elastic_step.hosts[0].log, 3)
+        loss_c = _final_loss(cont4_step.hosts[0].log, 3)
+        result["elastic_step_loss_3h"] = loss_e
+        result["elastic_step_loss_4h"] = loss_c
+        result["elastic_step_loss_rel"] = (
+            abs(loss_e - loss_c) / max(abs(loss_c), 1e-12)
+            if loss_e is not None and loss_c is not None else None
+        )
+        result.update(
+            {f"elastic_step_{k}": v
+             for k, v in _param_parity(ws, ws_cont4, 3, 2).items()}
+        )
+        ok_primitive = (
+            result["elastic_step_loss_rel"] is not None
+            and result["elastic_step_loss_rel"] <= 2e-4
+            and result["elastic_step_worst_update_rel"] <= 0.05
+            and result["elastic_step_leaves_compared"] > 0
+        )
+
+    # mechanism end-to-end: the 3-host workspace completes the run, and
+    # its final loss tracks an uninterrupted 3-host-from-scratch
+    # reference (trajectory sanity: catches divergence, not fp noise)
+    elastic_full = mh.launch(
+        ws, n_hosts=3, steps=6,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 4}),
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_elastic2"),
+    )
+    ws_ref = os.path.join(workdir, "mh_ws_ref3")
+    ref3 = mh.launch(
+        ws_ref, n_hosts=3, steps=6,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 4}),
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_ref3"),
+    )
+    result["elastic_full_returncodes"] = elastic_full.returncodes
+    result["ref3_returncodes"] = ref3.returncodes
+    result["elastic_final_step"] = ckpt.checkpoint_manager(ws).latest_step()
+    loss_full = _final_loss(elastic_full.hosts[0].log, 6)
+    loss_ref = _final_loss(ref3.hosts[0].log, 6)
+    result["elastic_final_loss"] = loss_full
+    result["ref3_final_loss"] = loss_ref
+    result["final_loss_rel_diff"] = (
+        abs(loss_full - loss_ref) / max(abs(loss_ref), 1e-12)
+        if loss_full is not None and loss_ref is not None else None
+    )
+    ok_elastic = (
+        ok_primitive
+        and all(rc == 0 for rc in elastic_full.returncodes)
+        and all(rc == 0 for rc in ref3.returncodes)
+        and result["elastic_final_step"] == 6
+        and result["final_loss_rel_diff"] is not None
+        and result["final_loss_rel_diff"] <= 0.10
+    )
+    result["elastic_ok"] = ok_elastic
+
+    # ---- phase D: bitwise same-topology resume across processes ---------
+    ws_bit = os.path.join(workdir, "mh_ws_bit")
+    bit1 = mh.launch(
+        ws_bit, n_hosts=2, steps=6,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 2}),
+        faults={0: "preempt_exit@step=3", 1: "preempt_exit@step=3"},
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_bit1"),
+    )
+    _clear_heartbeats(ws_bit)  # bit1 crashed on purpose; same hot-relaunch
+    bit2 = mh.launch(
+        ws_bit, n_hosts=2, steps=6,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 2}),
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_bit2"),
+    )
+    ws_bitref = os.path.join(workdir, "mh_ws_bitref")
+    bitref = mh.launch(
+        ws_bitref, n_hosts=2, steps=6,
+        overrides=dict(MULTIHOST_OVERRIDES,
+                       **{"data.per_gpu_batch_size": 2}),
+        timeout_s=timeout_s, workdir=os.path.join(workdir, "mh_bitref"),
+    )
+    result["bit_interrupt_returncodes"] = bit1.returncodes
+    result["bit_resume_returncodes"] = bit2.returncodes
+    result["bit_ref_returncodes"] = bitref.returncodes
+    ok_bit = (
+        all(rc not in (None, 0) for rc in bit1.returncodes)  # interrupted
+        and all(rc == 0 for rc in bit2.returncodes)
+        and all(rc == 0 for rc in bitref.returncodes)
+    )
+    if ok_bit:
+        mismatches = 0
+        resumed = _params_of(ws_bit, 6)
+        reference = _params_of(ws_bitref, 6)
+        for a, b in zip(
+            jax.tree.leaves(resumed), jax.tree.leaves(reference)
+        ):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                mismatches += 1
+        result["bitwise_mismatched_leaves"] = mismatches
+        ok_bit = mismatches == 0
+    result["bitwise_ok"] = ok_bit
+
+    result["ok"] = ok_kill and ok_elastic and ok_bit
+    return result
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--half",
-                        choices=("training", "serving", "fleet", "all"),
+                        choices=("training", "serving", "fleet",
+                                 "multihost", "all"),
                         default="all")
     parser.add_argument("--workdir", default=None,
                         help="scratch dir (default: a fresh tempdir)")
@@ -611,6 +934,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.half in ("fleet", "all"):
             verdict["fleet"] = fleet_half(args.timeout_s)
             ok = ok and verdict["fleet"]["ok"]
+        if args.half in ("multihost", "all"):
+            verdict["multihost"] = multihost_half(workdir, args.timeout_s)
+            ok = ok and verdict["multihost"]["ok"]
         # final step: the perf regression gate (obs/ledger.py, same verdict
         # `python tools/perf_ledger.py check` prints standalone) — a drill
         # that survives its faults but ships a perf regression still fails

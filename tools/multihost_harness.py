@@ -1,0 +1,286 @@
+#!/usr/bin/env python3
+"""Multi-host harness: drive a REAL `jax.distributed` multi-process
+training run as N subprocesses on one box — the same bring-up, mesh,
+collectives, per-host loaders, heartbeats, and checkpoint code path a pod
+runs, minus the hardware (gloo carries the cross-process collectives on
+CPU; parallel/mesh.py init_multihost).
+
+Each "host" is one subprocess with:
+
+  JAX_PLATFORMS=cpu                 one CPU device per process
+  MINE_TPU_MULTIHOST=127.0.0.1:P    the coordinator (host 0 binds it)
+  MINE_TPU_MULTIHOST_NPROCS=N
+  MINE_TPU_MULTIHOST_PROC_ID=i
+  MINE_TPU_FAULTS=<per-host spec>   optional chaos (resilience/chaos.py) —
+                                    `host_kill@step=K` only in the victim's
+                                    environment, etc.
+
+The driver each subprocess runs is the PRODUCT path: Trainer (which does
+the retrying bring-up), a per-host-sliced SyntheticDataset
+(Trainer.host_batch_slice — each host materializes only its `^batch/`
+rows), `fit()`. Stdout/stderr land in `<workdir>/host_<i>.log`.
+
+`launch()` is the library API the chaos drill's multihost half and the
+slow tests build on; the CLI wraps it for manual pokes:
+
+  python tools/multihost_harness.py --n-hosts 4 --steps 6 \\
+      --workspace /tmp/mh/ws --per-host-batch 3 \\
+      --fault 1:host_kill@step=3 --override resilience.multihost_watchdog_s=20
+
+Result anatomy (HostResult per host): returncode (negative = died by
+signal, resilience/multihost.py EXIT_HOST_STALL = the watchdog's named
+abort), timed_out, log text, last heartbeat (step + host-materialized data
+bytes), abort marker. `read_heartbeats`/`abort markers` come straight from
+resilience/multihost.py — the harness reads the same files an operator
+would.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from mine_tpu.resilience import multihost as mh  # noqa: E402
+
+_DRIVER = """\
+import json, sys
+sys.path.insert(0, {repo_root!r})
+from mine_tpu.utils.platform import honor_jax_platforms
+honor_jax_platforms()
+from mine_tpu.config import Config
+from mine_tpu.data import SyntheticDataset
+from mine_tpu.training.loop import Trainer
+
+overrides = json.loads(sys.argv[1])
+workspace, steps = sys.argv[2], int(sys.argv[3])
+cfg = Config().replace(**overrides)
+trainer = Trainer(cfg, workspace)
+# per-host data sharding: this host's loader materializes ONLY its
+# `^batch/` rows (bitwise the rows a global load would slice — synthetic
+# examples are seeded by global index)
+ds = SyntheticDataset(
+    cfg.data.img_h, cfg.data.img_w, trainer.global_batch,
+    steps_per_epoch=steps, n_points=32,
+    host_slice=trainer.host_batch_slice(),
+)
+trainer.fit(ds)
+print("HARNESS_DONE", flush=True)
+"""
+
+
+@dataclasses.dataclass
+class HostResult:
+    process_id: int
+    returncode: int | None  # None = still alive at the deadline (killed)
+    timed_out: bool
+    log: str
+
+    @property
+    def died_by_signal(self) -> int | None:
+        return -self.returncode if (
+            self.returncode is not None and self.returncode < 0
+        ) else None
+
+    @property
+    def watchdog_aborted(self) -> bool:
+        return self.returncode == mh.EXIT_HOST_STALL
+
+
+@dataclasses.dataclass
+class LaunchResult:
+    workspace: str
+    workdir: str
+    hosts: list[HostResult]
+    wall_s: float
+
+    @property
+    def returncodes(self) -> list[int | None]:
+        return [h.returncode for h in self.hosts]
+
+    def heartbeats(self) -> dict[int, dict]:
+        directory = os.path.join(self.workspace, "heartbeats")
+        out: dict[int, dict] = {}
+        for i in range(len(self.hosts)):
+            beat = mh.read_beat(mh.beat_path(directory, i))
+            if beat is not None:
+                out[i] = beat
+        return out
+
+    def abort_markers(self) -> dict[int, dict]:
+        return mh.abort_markers(os.path.join(self.workspace, "heartbeats"))
+
+    def flight_dump_dirs(self) -> dict[int, list[str]]:
+        """{process_id: flight dump dirs} — proves dumps landed in the
+        per-process subdirectories (obs/flight.py `p<idx>-<pid>/`)."""
+        root = os.path.join(self.workspace, "flight")
+        out: dict[int, list[str]] = {}
+        if not os.path.isdir(root):
+            return out
+        for sub in os.listdir(root):
+            if not sub.startswith("p"):
+                continue
+            idx = sub[1:].split("-", 1)[0]
+            if not idx.isdigit():
+                continue
+            dumps = [
+                os.path.join(root, sub, d)
+                for d in os.listdir(os.path.join(root, sub))
+                if d.startswith("flight_")
+            ]
+            out.setdefault(int(idx), []).extend(dumps)
+        return out
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(
+    workspace: str,
+    n_hosts: int,
+    steps: int,
+    overrides: dict | None = None,
+    faults: dict[int, str] | None = None,
+    timeout_s: float = 600.0,
+    workdir: str | None = None,
+) -> LaunchResult:
+    """Run one N-host training job to completion (or the deadline).
+
+    `faults` maps host index -> MINE_TPU_FAULTS spec for THAT host only —
+    how `host_kill@step=3` lands on exactly one victim. Hosts past the
+    deadline are SIGKILLed and report returncode None + timed_out: a
+    survivor that hangs instead of aborting shows up as exactly that."""
+    workdir = workdir or os.path.dirname(workspace) or "."
+    os.makedirs(workdir, exist_ok=True)
+    os.makedirs(workspace, exist_ok=True)
+    driver = os.path.join(workdir, "_mh_driver.py")
+    with open(driver, "w") as fh:
+        fh.write(_DRIVER.format(repo_root=REPO_ROOT))
+    port = free_port()
+    over = dict(overrides or {})
+    procs: list[subprocess.Popen] = []
+    logs: list[str] = []
+    for i in range(n_hosts):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO_ROOT,
+            MINE_TPU_MULTIHOST=f"127.0.0.1:{port}",
+            MINE_TPU_MULTIHOST_NPROCS=str(n_hosts),
+            MINE_TPU_MULTIHOST_PROC_ID=str(i),
+        )
+        # one CPU device per process: a preset multi-device flag would make
+        # every "host" a multi-chip host and change the mesh under the test
+        env.pop("XLA_FLAGS", None)
+        env["MINE_TPU_FAULTS"] = (faults or {}).get(i, "")
+        log_path = os.path.join(workdir, f"host_{i}.log")
+        logs.append(log_path)
+        procs.append(subprocess.Popen(
+            [sys.executable, driver, json.dumps(over), workspace, str(steps)],
+            env=env, cwd=REPO_ROOT,
+            stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+        ))
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    results: list[HostResult | None] = [None] * n_hosts
+    pending = set(range(n_hosts))
+    while pending and time.monotonic() < deadline:
+        for i in sorted(pending):
+            rc = procs[i].poll()
+            if rc is not None:
+                results[i] = HostResult(i, rc, False, "")
+                pending.discard(i)
+        if pending:
+            time.sleep(0.2)
+    for i in sorted(pending):  # past the deadline: the hang IS the verdict
+        procs[i].kill()
+        procs[i].wait()
+        results[i] = HostResult(i, None, True, "")
+    wall = time.monotonic() - t0
+    for i, r in enumerate(results):
+        try:
+            with open(logs[i]) as fh:
+                r.log = fh.read()
+        except OSError:
+            pass
+    return LaunchResult(workspace, workdir, list(results), wall)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--n-hosts", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=4)
+    parser.add_argument("--workspace", required=True)
+    parser.add_argument("--per-host-batch", type=int, default=1,
+                        help="data.per_gpu_batch_size (1 device per host)")
+    parser.add_argument("--fault", action="append", default=[],
+                        metavar="HOST:SPEC",
+                        help="per-host MINE_TPU_FAULTS, e.g. "
+                        "'1:host_kill@step=3' (repeatable)")
+    parser.add_argument("--override", action="append", default=[],
+                        metavar="KEY=VALUE", help="config overrides "
+                        "(YAML-parsed values), repeatable")
+    parser.add_argument("--timeout-s", type=float, default=600.0)
+    args = parser.parse_args(argv)
+
+    import yaml
+
+    overrides = {
+        "data.name": "synthetic",
+        "data.img_h": 128, "data.img_w": 128,
+        "data.per_gpu_batch_size": args.per_host_batch,
+        "data.num_workers": 0,
+        "model.num_layers": 18, "model.dtype": "float32",
+        "model.imagenet_pretrained": False,
+        "mpi.num_bins_coarse": 2,
+        "training.epochs": 1, "training.log_interval": 1,
+        "training.checkpoint_interval": 2,
+        "obs.enabled": True,
+        "resilience.multihost_watchdog_s": 20.0,
+    }
+    for row in args.override:
+        key, _, value = row.partition("=")
+        overrides[key.strip()] = yaml.safe_load(value)
+    faults: dict[int, str] = {}
+    for row in args.fault:
+        host, _, spec = row.partition(":")
+        faults[int(host)] = spec
+    result = launch(
+        args.workspace, args.n_hosts, args.steps, overrides,
+        faults=faults, timeout_s=args.timeout_s,
+    )
+    print(json.dumps({
+        "metric": "multihost_harness",
+        "n_hosts": args.n_hosts,
+        "returncodes": result.returncodes,
+        "timed_out": [h.timed_out for h in result.hosts],
+        "wall_s": round(result.wall_s, 1),
+        "heartbeats": {
+            str(i): {k: b.get(k) for k in ("step", "data_bytes", "done")}
+            for i, b in result.heartbeats().items()
+        },
+        "abort_markers": {
+            str(i): m.get("reason")
+            for i, m in result.abort_markers().items()
+        },
+        "flight_dumps": {
+            str(i): len(d) for i, d in result.flight_dump_dirs().items()
+        },
+    }))
+    clean = all(h.returncode == 0 for h in result.hosts)
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
