@@ -273,6 +273,16 @@ class ServingConfig:
     # re-running the encoder. This bounds the whole attempt; expiry
     # degrades to a local re-predict, never an error (serving/server.py).
     peer_fetch_timeout_s: float = 2.0
+    # SLO objectives (obs/slo.py), evaluated in rolling windows over the
+    # existing request counter/histogram families and published as
+    # mine_slo_{compliance,burn_rate,error_budget_remaining} gauges on
+    # every /metrics scrape (replicas AND the fleet router). Availability
+    # counts unplanned 5xx as errors (503 shedding is the admission-
+    # control contract, exempt by default); the latency objective reads
+    # "p95 <= slo_p95_ms over slo_window_s".
+    slo_availability_target: float = 0.995
+    slo_p95_ms: float = 2000.0
+    slo_window_s: float = 300.0
 
 
 @dataclass(frozen=True)

@@ -19,6 +19,13 @@ died with zero diagnostics):
     hbm_{live,peak}_bytes gauges + Chrome-trace counter events.
   * obs/ledger.py — append-only JSONL perf ledger with a rolling-baseline
     regression gate (tools/perf_ledger.py check).
+  * obs/collect.py — cross-process trace collection: merge N processes'
+    span rings (/debug/trace, host_spans_p*.trace.json) into one
+    skew-annotated timeline with per-process lanes, per-request hop
+    trees, and the multi-host training straggler attribution.
+  * obs/slo.py    — SLO/error-budget tracking: declarative availability +
+    latency objectives evaluated in rolling windows over the existing
+    metric families, published as mine_slo_* gauges.
 
 Everything is stdlib + jax-optional: the tracer, flight recorder, ledger
 and attribution parser never import jax at module level, so they work in
@@ -43,13 +50,16 @@ from mine_tpu.obs.cost import (
 )
 from mine_tpu.obs.flight import FlightRecorder
 from mine_tpu.obs.memlog import MemLog
-from mine_tpu.obs.trace import NULL_TRACER, Span, Tracer
+from mine_tpu.obs.slo import Objective, SLOTracker, default_objectives
+from mine_tpu.obs.trace import NULL_TRACER, Span, Tracer, new_span_id
 
 __all__ = [
     "COMPONENTS",
     "FlightRecorder",
     "MemLog",
     "NULL_TRACER",
+    "Objective",
+    "SLOTracker",
     "Span",
     "StepCost",
     "Tracer",
@@ -62,5 +72,7 @@ __all__ = [
     "compiled_cost",
     "component_of",
     "compute_mfu",
+    "default_objectives",
     "hlo_op_components",
+    "new_span_id",
 ]

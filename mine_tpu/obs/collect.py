@@ -1,0 +1,482 @@
+"""Cross-process trace collection: N span rings -> ONE timeline.
+
+Every process in this system keeps its own bounded span ring
+(obs/trace.py) and serves it as Chrome-trace JSON — replicas and the
+fleet router over `GET /debug/trace`, training processes as
+`host_spans_p<idx>.trace.json` exports in the shared sidecar. Each ring
+is honest about ITS process and blind to every other, so the questions
+that matter on a fleet ("where did this request's time go ACROSS the
+wire?") and on a pod ("which host is the straggler?") need a collector:
+
+  fetch_member_trace   pull one member's /debug/trace over HTTP, measuring
+                       the probe round-trip and estimating the member's
+                       wall-clock skew against the collector's clock from
+                       the export's clock anchor (obs/trace.py metadata);
+                       the estimate (± rtt/2 uncertainty) is RECORDED in
+                       the merged doc, never silently ignored.
+  merge_member_traces  rebase every member's spans onto one wall-clock
+                       epoch (skew-corrected) and renumber pids so each
+                       member renders as its own named process lane in
+                       Perfetto / tools/profile_summary.py.
+  request_tree         one request's spans across every lane, assembled
+                       into the cross-process hop tree via the
+                       span_id / parent_span args the trace context
+                       propagates (X-Request-Id + X-Parent-Span headers).
+  collect_fleet_trace  the one-call fleet assembly: members (+ optionally
+                       the router's own ring) -> merged doc; powers the
+                       router's aggregated GET /debug/trace?request_id=
+                       and the fleet CLI's `trace` subcommand.
+  training_timeline    the multi-host training half: merge the per-host
+                       host_spans_p*.trace.json exports from one shared
+                       sidecar, compute per-host step-time and sync-wait
+                       distributions from the step/sync spans, and attach
+                       the heartbeat-derived straggler table
+                       (resilience/multihost.py).
+
+Stdlib-only (urllib for the fetch), like the rest of obs/ — it must work
+from an offline operator shell against saved trace files too.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable
+
+from mine_tpu.obs.trace import (
+    HOST_PROCESS_NAME,
+    PARENT_SPAN_ARG,
+    REQUEST_ID_ARG,
+    SPAN_ID_ARG,
+)
+
+MERGED_PRODUCER = "mine_tpu trace merge"
+
+
+def _http_get_json(url: str, timeout_s: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def fetch_member_trace(
+    name: str,
+    base_url: str,
+    request_id: str | None = None,
+    timeout_s: float = 5.0,
+    fetch_fn: Callable[[str, float], dict] | None = None,
+    now_fn: Callable[[], float] = time.time,
+) -> dict:
+    """One member's ring as {"name", "doc", "skew_s", "rtt_s"} or
+    {"name", "error"}. Skew = member wall clock minus the collector's,
+    estimated by anchoring the export's wall timestamp at the probe
+    midpoint; |error| <= rtt/2, and rtt rides along so a consumer can
+    judge the estimate instead of trusting it blindly."""
+    url = base_url.rstrip("/") + "/debug/trace"
+    if request_id:
+        # defense in depth behind the surfaces' charset guards: an odd
+        # id must not corrupt the fetch URL (every member would then
+        # read as unreachable — a fake fleet-wide outage)
+        url += "?request_id=" + urllib.parse.quote(request_id, safe="")
+    fetch = fetch_fn if fetch_fn is not None else _http_get_json
+    t0 = now_fn()
+    try:
+        doc = fetch(url, timeout_s)
+    except Exception as exc:  # noqa: BLE001 - per-member verdicts
+        return {"name": name, "error": f"{type(exc).__name__}: {exc}"}
+    t1 = now_fn()
+    clock = (doc.get("metadata") or {}).get("clock") or {}
+    skew = None
+    if "exported_unix_s" in clock:
+        skew = float(clock["exported_unix_s"]) - (t0 + t1) / 2.0
+    return {
+        "name": name, "doc": doc,
+        "skew_s": skew, "rtt_s": t1 - t0,
+    }
+
+
+def _wall_offset(doc: dict, skew_s: float | None) -> float:
+    """Seconds to ADD to (ts_us / 1e6) to land this doc's events on the
+    collector's wall clock. Docs without a clock anchor (foreign traces)
+    keep their raw timebase (offset 0) — recorded as unanchored."""
+    clock = (doc.get("metadata") or {}).get("clock") or {}
+    if "exported_unix_s" not in clock:
+        return 0.0
+    return (float(clock["exported_unix_s"])
+            - float(clock.get("exported_ts_us", 0.0)) / 1e6
+            - (skew_s or 0.0))
+
+
+def _explode_if_merged(member: dict) -> list[dict]:
+    """A member whose doc is ITSELF a merged trace (the fleet CLI fetched
+    the router's /debug/trace?request_id=, which aggregates) explodes
+    back into one pseudo-member per inner lane — re-merging a merged doc
+    as one member would collapse its lanes onto a single pid and
+    double-count any replica that was also fetched directly. Exploded
+    members keep the inner names (deduped against direct fetches by
+    merge_member_traces) and anchor on the merged doc's epoch."""
+    doc = member.get("doc") or {}
+    meta = doc.get("metadata") or {}
+    if meta.get("producer") != MERGED_PRODUCER:
+        return [member]
+    epoch = float(meta.get("epoch_unix_s", 0.0))
+    # the fetch measured ONE skew for the whole merged doc (the source
+    # router's clock vs ours) — it applies to every inner lane, so it
+    # must ride along or the exploded lanes land uncorrected next to
+    # directly-fetched ones (the "skew recorded, never ignored" contract)
+    outer_skew = member.get("skew_s")
+    inner_names = {
+        m["pid"]: name
+        for name, m in (meta.get("members") or {}).items()
+        if isinstance(m, dict) and "pid" in m
+    }
+    by_pid: dict[Any, list[dict]] = {}
+    for ev in doc.get("traceEvents", ()):
+        by_pid.setdefault(ev.get("pid"), []).append(ev)
+    out: list[dict] = []
+    for pid in sorted(by_pid, key=str):
+        inner = inner_names.get(pid, f"{member['name']}:pid{pid}")
+        events = []
+        for ev in by_pid[pid]:
+            ev = dict(ev)
+            if (ev.get("ph") == "M" and ev.get("name") == "process_name"):
+                args = dict(ev.get("args") or {})
+                lane = str(args.get("name", HOST_PROCESS_NAME))
+                # strip the "<inner> · " prefix the previous merge added,
+                # so this merge does not stack prefixes
+                prefix = f"{inner} · "
+                if lane.startswith(prefix):
+                    args["name"] = lane[len(prefix):]
+                ev["args"] = args
+            events.append(ev)
+        out.append({
+            "name": inner,
+            "_exploded": True,
+            "skew_s": outer_skew,
+            "rtt_s": member.get("rtt_s"),
+            "doc": {
+                "traceEvents": events,
+                "metadata": {"clock": {
+                    "exported_unix_s": epoch, "exported_ts_us": 0.0,
+                }},
+            },
+        })
+    return out
+
+
+def merge_member_traces(members: list[dict]) -> dict:
+    """Members (fetch_member_trace results, or hand-built
+    {"name", "doc"[, "skew_s", "rtt_s"]} dicts) -> one Chrome-trace doc.
+
+    Each member becomes its own pid lane named "<member> · <orig lane>";
+    ts values are rebased onto one epoch (the earliest skew-corrected
+    wall instant across members), so lanes line up the way the requests
+    actually interleaved. Unreachable members appear in metadata, not as
+    silently missing lanes. A member that is itself a merged doc is
+    exploded back into its inner lanes first (_explode_if_merged), and
+    an exploded lane whose name a direct fetch also covers is dropped —
+    the direct fetch carries a real skew estimate, the copy inside the
+    merged doc would double-count the same spans."""
+    exploded: list[dict] = []
+    for m in members:
+        exploded.extend(_explode_if_merged(m) if "doc" in m else [m])
+    direct_names = {m["name"] for m in exploded
+                    if "doc" in m and not m.get("_exploded")}
+    members = [
+        m for m in exploded
+        if not (m.get("_exploded") and m["name"] in direct_names)
+    ]
+    seen: set[str] = set()
+    deduped: list[dict] = []
+    for m in members:  # two directs with one name: first wins, noted
+        if "doc" in m and m["name"] in seen:
+            deduped.append({"name": f"{m['name']} (duplicate)",
+                            "error": "duplicate member name, dropped"})
+            continue
+        seen.add(m["name"])
+        deduped.append(m)
+    members = deduped
+    events: list[dict] = []
+    meta_members: dict[str, dict] = {}
+    offsets: list[tuple[dict, float]] = []
+    epoch: float | None = None
+    ok_members = [m for m in members if "doc" in m]
+    for m in ok_members:
+        off = _wall_offset(m["doc"], m.get("skew_s"))
+        offsets.append((m, off))
+        for ev in m["doc"].get("traceEvents", ()):
+            if ev.get("ph") == "X":
+                wall = off + float(ev.get("ts", 0.0)) / 1e6
+                epoch = wall if epoch is None else min(epoch, wall)
+    if epoch is None:
+        epoch = 0.0
+    for i, (m, off) in enumerate(offsets):
+        pid = i + 1
+        doc = m["doc"]
+        meta_members[m["name"]] = {
+            "pid": pid,
+            "skew_s": m.get("skew_s"),
+            "rtt_s": m.get("rtt_s"),
+            "dropped_spans": (doc.get("metadata") or {}).get(
+                "dropped_spans", 0
+            ),
+            "clock_anchored": bool(
+                ((doc.get("metadata") or {}).get("clock") or {})
+                .get("exported_unix_s")
+            ),
+        }
+        named = False
+        for ev in doc.get("traceEvents", ()):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    args = dict(ev.get("args") or {})
+                    args["name"] = f"{m['name']} · " + str(
+                        args.get("name", HOST_PROCESS_NAME)
+                    )
+                    ev["args"] = args
+                    named = True
+            elif ev.get("ph") in ("X", "C", "I"):
+                ev["ts"] = round(
+                    (off + float(ev.get("ts", 0.0)) / 1e6 - epoch) * 1e6, 3
+                )
+            events.append(ev)
+        if not named:  # a doc with no process metadata still gets a lane
+            events.append({
+                "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                "args": {"name": f"{m['name']} · {HOST_PROCESS_NAME}"},
+            })
+    for m in members:
+        if "doc" not in m:
+            meta_members[m["name"]] = {"error": m.get("error", "unreachable")}
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "metadata": {
+            "producer": MERGED_PRODUCER,
+            "epoch_unix_s": epoch,
+            "members": meta_members,
+        },
+    }
+
+
+# ------------------------------------------------------ per-request tree
+
+
+def _matches_request(ev: dict, request_id: str) -> bool:
+    if ev.get("ph") != "X":
+        return False
+    args = ev.get("args") or {}
+    if args.get(REQUEST_ID_ARG) == request_id:
+        return True
+    return request_id in str(args.get("request_ids", "")).split(",")
+
+
+def filter_doc_to_request(doc: dict, request_id: str) -> dict:
+    """The doc reduced to ONE request: metadata (`M`) events kept, `X`
+    spans kept only when they carry this request id. The single matching
+    rule for every surface — the replica's /debug/trace?request_id=, the
+    router's own-lane contribution to an aggregated trace — so a span
+    that one surface counts as the request's can never be one another
+    surface drops."""
+    out = dict(doc)
+    out["traceEvents"] = [
+        ev for ev in doc.get("traceEvents", ())
+        if ev.get("ph") == "M" or _matches_request(ev, request_id)
+    ]
+    meta = dict(doc.get("metadata") or {})
+    meta["request_id"] = request_id
+    out["metadata"] = meta
+    return out
+
+
+def request_tree(doc: dict, request_id: str) -> dict:
+    """One request's spans out of a (merged or single-process) doc, plus
+    the cross-process hop tree. Tree nodes are the spans that carry a
+    span_id and/or parent_span arg — the hop boundaries the trace context
+    crossed; spans with only a request_id (engine internals, encode, …)
+    stay in `events` but out of the tree. A parent_span pointing at a
+    span we never saw (its ring dropped it) makes the child a root —
+    evidence keeps partial trees, it does not discard them."""
+    pid_names: dict[Any, str] = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev.get("pid")] = (ev.get("args") or {}).get("name", "?")
+    kept = [ev for ev in doc.get("traceEvents", ())
+            if _matches_request(ev, request_id)]
+    nodes: dict[str, dict] = {}
+    ordered: list[tuple[dict, dict]] = []
+    for ev in kept:
+        args = ev.get("args") or {}
+        sid = args.get(SPAN_ID_ARG)
+        parent = args.get(PARENT_SPAN_ARG)
+        if sid is None and parent is None:
+            continue
+        node = {
+            "name": ev.get("name"),
+            "process": pid_names.get(ev.get("pid"), str(ev.get("pid"))),
+            "span_id": sid,
+            "parent_span": parent,
+            "ts_us": ev.get("ts"),
+            "dur_us": ev.get("dur"),
+            "children": [],
+        }
+        if sid is not None:
+            nodes[sid] = node
+        ordered.append((node, ev))
+    roots: list[dict] = []
+    for node, _ in ordered:
+        parent = node["parent_span"]
+        if parent is not None and parent in nodes and nodes[parent] is not node:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    return {
+        "request_id": request_id,
+        "processes": sorted({
+            pid_names.get(ev.get("pid"), str(ev.get("pid"))) for ev in kept
+        }),
+        "span_count": len(kept),
+        "tree": roots,
+        "events": kept,
+    }
+
+
+def tree_depth(tree: list[dict]) -> int:
+    """Longest root-to-leaf hop chain — the "crosses N processes" check
+    the acceptance test and the CLI print."""
+    if not tree:
+        return 0
+    return 1 + max(tree_depth(n["children"]) for n in tree)
+
+
+# ------------------------------------------------------ fleet collection
+
+
+def collect_fleet_trace(
+    members: dict[str, str],
+    request_id: str | None = None,
+    local: dict | None = None,
+    timeout_s: float = 5.0,
+    fetch_fn: Callable[[str, float], dict] | None = None,
+) -> dict:
+    """Pull every member's ring (optionally filtered to one request) and
+    merge. `local` is an already-in-hand doc (the router's own ring) as
+    {"name": ..., "doc": ...} — skew 0 by definition: the collector IS
+    that process.
+
+    Members fetch CONCURRENTLY: the fetches are independent, and this
+    runs on the router's handler thread — a sequential walk over K
+    unreachable replicas (exactly the incident an operator pulls a trace
+    to debug) would block ~K x timeout before answering; the fan-out
+    bounds the wall time to roughly one timeout."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    fetched = [local] if local else []
+    if members:
+        with ThreadPoolExecutor(
+            max_workers=min(8, len(members)),
+            thread_name_prefix="mine-trace-fetch",
+        ) as pool:
+            fetched.extend(pool.map(
+                lambda item: fetch_member_trace(
+                    item[0], item[1], request_id=request_id,
+                    timeout_s=timeout_s, fetch_fn=fetch_fn,
+                ),
+                list(members.items()),
+            ))
+    doc = merge_member_traces(fetched)
+    if request_id:
+        doc["metadata"]["request_id"] = request_id
+        doc["metadata"]["request_tree"] = {
+            k: v for k, v in request_tree(doc, request_id).items()
+            if k != "events"  # the events ARE traceEvents already
+        }
+    return doc
+
+
+# ------------------------------------------- multi-host training timeline
+
+_HOST_SPANS_RE = re.compile(r"host_spans(?:_p(\d+))?\.trace\.json$")
+
+
+def _span_stats(durs_us: list[float]) -> dict:
+    if not durs_us:
+        return {"count": 0}
+    durs = sorted(durs_us)
+    n = len(durs)
+    return {
+        "count": n,
+        "mean_ms": round(sum(durs) / n / 1e3, 3),
+        "p50_ms": round(durs[n // 2] / 1e3, 3),
+        "p95_ms": round(durs[min(n - 1, int(0.95 * (n - 1)))] / 1e3, 3),
+        "max_ms": round(durs[-1] / 1e3, 3),
+    }
+
+
+def training_timeline(sidecar_dir: str) -> dict:
+    """Merge a (possibly multi-process) training run's host-span exports
+    and heartbeats into one timeline + attribution block.
+
+    Returns {"doc": merged Chrome trace, "per_host": {idx: {"step": …,
+    "sync_wait": …}} (distributions off each host's step/sync spans),
+    "stragglers": the heartbeat-derived table (resilience/multihost.py
+    straggler_table — slowest host, skew fraction), or raises
+    FileNotFoundError when the sidecar holds no host-span export."""
+    pattern = os.path.join(sidecar_dir, "profile", "host_spans*.trace.json")
+    paths = sorted(glob.glob(pattern))
+    # a multi-process run writes host_spans_p<idx> files; a bare
+    # host_spans.trace.json next to them is a PREVIOUS single-process
+    # run's leftover and would collide with p0 — prefer the explicit
+    # per-process layout whenever it exists (the Trainer additionally
+    # clears previous-run exports at multi-process start)
+    p_files = [p for p in paths
+               if _HOST_SPANS_RE.search(os.path.basename(p))
+               and _HOST_SPANS_RE.search(os.path.basename(p)).group(1)]
+    if p_files:
+        paths = p_files
+    members: list[dict] = []
+    per_host: dict[int, dict] = {}
+    for path in paths:
+        match = _HOST_SPANS_RE.search(os.path.basename(path))
+        if not match:
+            continue
+        idx = int(match.group(1) or 0)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        members.append({"name": f"p{idx}", "doc": doc, "skew_s": 0.0})
+        steps: list[float] = []
+        syncs: list[float] = []
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") != "X" or ev.get("cat") != "train":
+                continue
+            if ev.get("name") == "step":
+                steps.append(float(ev.get("dur", 0.0)))
+            elif ev.get("name") == "sync":
+                syncs.append(float(ev.get("dur", 0.0)))
+        per_host[idx] = {
+            "step": _span_stats(steps),
+            "sync_wait": _span_stats(syncs),
+        }
+    if not members:
+        raise FileNotFoundError(
+            f"no host_spans*.trace.json under {sidecar_dir}/profile "
+            "(obs.enabled runs export them; multi-process runs one per "
+            "process)"
+        )
+    out = {"doc": merge_member_traces(members), "per_host": per_host}
+    hb_dir = os.path.join(sidecar_dir, "heartbeats")
+    if os.path.isdir(hb_dir):
+        from mine_tpu.resilience.multihost import straggler_table
+
+        out["stragglers"] = straggler_table(hb_dir)
+    return out

@@ -75,6 +75,33 @@ def git_rev(cwd: str | None = None) -> str | None:
         return None
 
 
+def set_build_info(registry: Any, backend: str | None = None) -> None:
+    """Publish the `mine_build_info{git_rev,jax_version,backend}` info
+    gauge (constant value 1, the Prometheus info-metric idiom) on a
+    metrics registry. One helper so the training gauges, every replica's
+    /metrics, and the fleet router all spell the labels identically — a
+    scrape then joins perf-ledger rows (which already carry git_rev)
+    without guesswork. `backend` stays whatever the caller KNOWS: the
+    router never initializes a jax backend and passes None ("none") —
+    this helper must not probe one into existence just for a label."""
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # noqa: BLE001 - an info gauge must never crash
+        jax_version = "unknown"
+    registry.gauge(
+        "mine_build_info",
+        "build/runtime identity (value is always 1; the labels are the "
+        "payload): git revision, jax version, backend",
+    ).set(
+        1,
+        git_rev=git_rev() or "unknown",
+        jax_version=jax_version,
+        backend=backend or "none",
+    )
+
+
 def config_digest(workload: dict[str, Any]) -> str:
     """Short stable digest of the workload knobs that make two rows
     comparable (shape, batch, planes, ... — NOT the measured values)."""

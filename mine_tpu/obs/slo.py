@@ -1,0 +1,313 @@
+"""SLO / error-budget tracking over the existing metric families.
+
+The serving stack already counts everything an availability or latency
+objective needs — `mine_serve_requests_total{endpoint,status}` /
+`mine_fleet_requests_total` and the cumulative-bucket latency histograms —
+so an SLO layer must not grow a second accounting path that could drift
+from the one the dashboards scrape. This module evaluates DECLARATIVE
+objectives directly over those families in rolling windows:
+
+  Objective   one target: `availability` (fraction of non-error responses)
+              or `latency` (fraction of requests answered within
+              `threshold_s` — target 0.95 + threshold == "p95 <= t").
+  SLOTracker  snapshots the counter/histogram children on each evaluate()
+              call, diffs against the oldest snapshot inside `window_s`,
+              and publishes three gauges per objective on the SAME
+              registry the families live in:
+
+                mine_slo_compliance{slo}             good / total
+                mine_slo_burn_rate{slo}              error rate / budget
+                mine_slo_error_budget_remaining{slo} 1 - burn rate
+
+              burn rate 1.0 means errors arrive exactly at the budgeted
+              rate (compliance == target); > 1.0 means the objective is
+              being violated; remaining goes negative then — honest, not
+              clamped.
+
+Error semantics (availability): an error is any 5xx EXCEPT the statuses in
+`exempt_statuses` (default: 503). A 503 here is the admission-control
+contract working — queue bound, open breaker, router cooldown — an honest
+"retry later" WITH a Retry-After that clients are documented to honor, and
+the chaos drill floods past those bounds on purpose. An unplanned 500/502
+(and a 504: a deadline miss IS user-visible unavailability) burns budget.
+Operators who count shedding as unavailability set exempt_statuses=().
+
+An EMPTY window (no traffic) is a vacuous pass: compliance 1.0, burn 0 —
+an idle replica is not violating its SLO, and a drill phase that produced
+zero requests should fail its own request-count assertion, not the SLO's.
+
+Consumers: ServingApp (replicas) and FleetApp (router) each own a tracker
+evaluated on every /metrics scrape; tools/bench_fleet.py and the chaos
+drill's fleet half read `verdict()` — availability + p95 burn rate — as a
+pass/fail block in their JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from mine_tpu.utils.metrics import Counter, Histogram, MetricsRegistry
+
+# endpoints whose responses count toward availability: the product surface.
+# Scrape/introspection endpoints (/metrics, /healthz, /debug/trace) are
+# deliberately excluded — a health checker's 503 on a draining replica is
+# the health contract, not user-visible unavailability.
+DEFAULT_ENDPOINTS = ("predict", "render", "mpi")
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declarative objective over an existing metric family.
+
+    kind "availability": `family` is a requests-total style counter with
+    `endpoint` and `status` labels; compliance = non-error / total over
+    the window, restricted to `endpoints`.
+
+    kind "latency": `family` is a cumulative-bucket histogram with an
+    `endpoint` label; compliance = fraction of window observations with
+    value <= `threshold_s` (interpolated inside the containing bucket), so
+    target 0.95 reads "p95 latency <= threshold_s"."""
+
+    name: str
+    kind: str  # "availability" | "latency"
+    family: str
+    target: float
+    threshold_s: float = 0.0
+    endpoints: tuple[str, ...] = DEFAULT_ENDPOINTS
+    exempt_statuses: tuple[int, ...] = (503,)
+    window_s: float = 300.0
+
+    def __post_init__(self):
+        if self.kind not in ("availability", "latency"):
+            raise ValueError(f"objective {self.name}: unknown kind "
+                             f"{self.kind!r} (availability|latency)")
+        if not (0.0 < self.target <= 1.0):
+            raise ValueError(f"objective {self.name}: target {self.target} "
+                             "must be in (0, 1]")
+        if self.kind == "latency" and self.threshold_s <= 0:
+            raise ValueError(f"objective {self.name}: latency objectives "
+                             "need threshold_s > 0")
+
+
+def default_objectives(
+    availability_target: float = 0.995,
+    p95_s: float = 2.0,
+    window_s: float = 300.0,
+    family_prefix: str = "mine_serve",
+) -> tuple[Objective, ...]:
+    """The standard pair both surfaces ship: availability over the
+    requests counter + p95 over the request-latency histogram. The fleet
+    router passes family_prefix='mine_fleet'."""
+    return (
+        Objective(
+            name="availability", kind="availability",
+            family=f"{family_prefix}_requests_total",
+            target=availability_target, window_s=window_s,
+        ),
+        Objective(
+            name="latency_p95", kind="latency",
+            family=f"{family_prefix}_request_latency_seconds",
+            target=0.95, threshold_s=p95_s, window_s=window_s,
+        ),
+    )
+
+
+@dataclass
+class _Snapshot:
+    ts: float
+    # availability: (good, total); latency: (within_threshold, total) —
+    # both already reduced to two floats, so the deque stays tiny no
+    # matter how many label children the family grows
+    good: float = 0.0
+    total: float = 0.0
+
+
+class SLOTracker:
+    """Rolling-window evaluator for a set of objectives on one registry.
+
+    evaluate() is cheap (a dict snapshot per family under the registry
+    lock) and is wired to the /metrics scrape, so the gauges are exactly
+    as fresh as everything else on the page. Thread-safe: scrapes and
+    drill threads may evaluate concurrently."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        objectives: tuple[Objective, ...] | list[Objective],
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if not objectives:
+            raise ValueError("SLOTracker needs at least one objective")
+        names = [o.name for o in objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names: {sorted(names)}")
+        self.registry = registry
+        self.objectives = tuple(objectives)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._history: dict[str, deque[_Snapshot]] = {
+            o.name: deque() for o in self.objectives
+        }
+        # baseline at construction: the first evaluate() then measures
+        # "since the tracker existed", not a vacuous zero-width window
+        # (the chaos drill builds a tracker right before a flood phase and
+        # reads the verdict right after — that diff must see the flood)
+        now0 = self.clock()
+        for obj in self.objectives:
+            self._history[obj.name].append(
+                _Snapshot(now0, *self._reduce(obj))
+            )
+        self.compliance = registry.gauge(
+            "mine_slo_compliance",
+            "fraction of in-window requests meeting the objective, by slo "
+            "(1.0 on an empty window — idle is not a violation)",
+        )
+        self.burn_rate = registry.gauge(
+            "mine_slo_burn_rate",
+            "in-window error rate over the error budget (1 - target), by "
+            "slo: 1.0 = burning exactly at budget, > 1.0 = violating",
+        )
+        self.budget_remaining = registry.gauge(
+            "mine_slo_error_budget_remaining",
+            "1 - burn_rate, by slo — negative when the window has already "
+            "overspent its budget (honest, not clamped)",
+        )
+
+    # -- family reduction ------------------------------------------------------
+
+    def _reduce(self, obj: Objective) -> tuple[float, float]:
+        """(good, cumulative total) for one objective right now."""
+        family = self.registry._families.get(obj.family)
+        if family is None:
+            return 0.0, 0.0
+        if obj.kind == "availability":
+            if not isinstance(family, Counter):
+                raise TypeError(
+                    f"objective {obj.name}: {obj.family} is "
+                    f"{family.kind}, availability needs a counter"
+                )
+            good = total = 0.0
+            for labels, value in family.labeled_values().items():
+                d = dict(labels)
+                if obj.endpoints and d.get("endpoint") not in obj.endpoints:
+                    continue
+                total += value
+                if not self._is_error(d.get("status", ""), obj):
+                    good += value
+            return good, total
+        if not isinstance(family, Histogram):
+            raise TypeError(
+                f"objective {obj.name}: {obj.family} is {family.kind}, "
+                "latency needs a histogram"
+            )
+        good = total = 0.0
+        edges = list(family.buckets) + [float("inf")]
+        for labels, counts in family.labeled_buckets().items():
+            d = dict(labels)
+            if obj.endpoints and d.get("endpoint") not in obj.endpoints:
+                continue
+            cum = 0.0
+            within = None
+            prev_edge, prev_cum = 0.0, 0.0
+            for edge, n in zip(edges, counts):
+                cum += n
+                if within is None and obj.threshold_s <= edge:
+                    if edge == float("inf"):
+                        # threshold beyond the last finite bucket: only
+                        # observations provably <= that last edge count
+                        # as good — the +Inf bucket holds arbitrarily
+                        # slow requests and MUST NOT vacuously satisfy
+                        # the objective (a 10-minute request is not
+                        # "within" a 100s p95)
+                        within = prev_cum
+                    elif edge == prev_edge:
+                        within = cum
+                    else:
+                        # interpolate inside the containing bucket (the
+                        # same linear assumption Histogram.quantile makes)
+                        frac = ((obj.threshold_s - prev_edge)
+                                / (edge - prev_edge))
+                        within = prev_cum + frac * (cum - prev_cum)
+                prev_edge, prev_cum = edge, cum
+            total += cum
+            good += cum if within is None else min(within, cum)
+        return good, total
+
+    @staticmethod
+    def _is_error(status: str, obj: Objective) -> bool:
+        try:
+            code = int(status)
+        except (TypeError, ValueError):
+            return False  # unlabeled/odd children never burn budget
+        return code >= 500 and code not in obj.exempt_statuses
+
+    # -- evaluation ------------------------------------------------------------
+
+    def evaluate(self, now: float | None = None) -> dict[str, dict[str, Any]]:
+        """Snapshot, window, publish gauges; returns {name: verdict}."""
+        now = self.clock() if now is None else now
+        out: dict[str, dict[str, Any]] = {}
+        with self._lock:
+            for obj in self.objectives:
+                good, total = self._reduce(obj)
+                hist = self._history[obj.name]
+                hist.append(_Snapshot(now, good, total))
+                # baseline = the NEWEST snapshot at least window_s old (so
+                # the diff spans the full window), else the oldest held
+                while len(hist) > 1 and hist[1].ts <= now - obj.window_s:
+                    hist.popleft()
+                base = hist[0]
+                w_total = total - base.total
+                w_good = good - base.good
+                if w_total <= 0:
+                    compliance, burn = 1.0, 0.0  # vacuous pass (docstring)
+                else:
+                    compliance = max(0.0, min(1.0, w_good / w_total))
+                    budget = max(1.0 - obj.target, 1e-9)
+                    burn = (1.0 - compliance) / budget
+                remaining = 1.0 - burn
+                self.compliance.set(compliance, slo=obj.name)
+                self.burn_rate.set(burn, slo=obj.name)
+                self.budget_remaining.set(remaining, slo=obj.name)
+                out[obj.name] = {
+                    "slo": obj.name,
+                    "kind": obj.kind,
+                    "target": obj.target,
+                    "window_requests": round(w_total, 1),
+                    "compliance": round(compliance, 6),
+                    "burn_rate": round(burn, 4),
+                    "error_budget_remaining": round(remaining, 4),
+                    "ok": burn <= 1.0,
+                }
+                if obj.kind == "latency":
+                    out[obj.name]["threshold_s"] = obj.threshold_s
+        return out
+
+    def verdict(self, now: float | None = None) -> dict[str, Any]:
+        """The pass/fail block bench_fleet and the chaos drill embed: one
+        evaluate() plus the conjunction."""
+        per = self.evaluate(now)
+        return {
+            "objectives": per,
+            "ok": all(v["ok"] for v in per.values()),
+        }
+
+
+def tracker_from_config(
+    registry: MetricsRegistry,
+    cfg: Any,
+    family_prefix: str = "mine_serve",
+) -> SLOTracker:
+    """The config-driven constructor ServingApp uses: serving.slo_* knobs
+    into the default objective pair."""
+    s = cfg.serving
+    return SLOTracker(registry, default_objectives(
+        availability_target=s.slo_availability_target,
+        p95_s=s.slo_p95_ms / 1e3,
+        window_s=s.slo_window_s,
+        family_prefix=family_prefix,
+    ))

@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
+import uuid
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -34,6 +36,49 @@ from typing import Any, Callable
 # the process-lane name host exports carry; tools/profile_summary.py keys
 # its host-vs-device lane split on this string
 HOST_PROCESS_NAME = "mine_tpu host spans"
+
+# span args the cross-process trace context rides in (obs/collect.py
+# assembles the per-request tree from exactly these): `span_id` names a
+# span so a downstream hop can point back at it, `parent_span` is the
+# upstream hop's span_id (arrived as the X-Parent-Span header), and
+# `request_id` is the trace id (the existing X-Request-Id)
+SPAN_ID_ARG = "span_id"
+PARENT_SPAN_ARG = "parent_span"
+REQUEST_ID_ARG = "request_id"
+
+# the HTTP spellings of the trace context (one definition: the router,
+# the replica server, and the peer-fetch client all propagate these)
+REQUEST_ID_HEADER = "X-Request-Id"
+PARENT_SPAN_HEADER = "X-Parent-Span"
+
+# charset guard for BOTH context headers: a value is echoed into response
+# headers and span args, so anything that could smuggle newlines or
+# unbounded bytes gets replaced (request id: minted; parent span:
+# dropped). One spelling — the router and the replica server must never
+# disagree on what a well-formed token is.
+TRACE_TOKEN_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
+
+
+def new_span_id() -> str:
+    """A fresh span id for a cross-process hop (forward, peer fetch, swap
+    fan-out): short enough to ride a header, unique enough per ring."""
+    return uuid.uuid4().hex[:12]
+
+
+def resolve_request_id(raw: str | None) -> str:
+    """The caller-supplied request id when well-formed (TRACE_TOKEN_RE),
+    else a minted one — every request gets an addressable trace id. ONE
+    implementation for the router and the replica handlers: the mint
+    shape and the charset rule must never drift between them."""
+    if raw and TRACE_TOKEN_RE.match(raw):
+        return raw
+    return uuid.uuid4().hex[:16]
+
+
+def resolve_parent_span(raw: str | None) -> str | None:
+    """The upstream hop's span id when well-formed, else None (a
+    malformed parent is dropped, never echoed into span args)."""
+    return raw if raw and TRACE_TOKEN_RE.match(raw) else None
 
 
 @dataclass(frozen=True)
@@ -259,6 +304,19 @@ class Tracer:
             "metadata": {
                 "producer": HOST_PROCESS_NAME,
                 "dropped_spans": self.dropped,
+                # clock anchor: the tracer-timebase instant and the wall
+                # clock AT EXPORT, captured back to back. A collector maps
+                # any span ts onto this process's wall clock as
+                #   wall_s = exported_unix_s + (ts_us - exported_ts_us)/1e6
+                # which is what lets N processes' rings merge into ONE
+                # timeline (obs/collect.py; residual skew between the
+                # processes' wall clocks is estimated from probe round
+                # trips there and recorded, never silently ignored).
+                "clock": {
+                    "exported_ts_us": (time.perf_counter() - self._epoch)
+                    * 1e6,
+                    "exported_unix_s": time.time(),
+                },
             },
         }
 

@@ -226,6 +226,18 @@ def bring_up(
 
 # ------------------------------------------------------- heartbeat exchange
 
+# THE heartbeat file schema. Required keys appear in every beat; optional
+# keys only when the writer had the value. Three independent consumers
+# read these files — the cross-host watchdog, the straggler table below,
+# and the trace collector (obs/collect.py training_timeline) — so the
+# contract is pinned by a tier-1 test (tests/test_multihost.py): a writer
+# or reader drifting from it fails with the key named, not with a
+# silently-wrong verdict.
+BEAT_REQUIRED_KEYS = frozenset(
+    {"process_index", "pid", "ts", "step", "data_bytes", "done"}
+)
+BEAT_OPTIONAL_KEYS = frozenset({"allowance_s", "sync_wait_ms"})
+
 
 def beat_path(directory: str, process_index: int) -> str:
     return os.path.join(directory, f"host_{process_index}.json")
@@ -256,11 +268,16 @@ class HeartbeatWriter:
         os.makedirs(directory, exist_ok=True)
 
     def beat(self, step: int | None = None, data_bytes: int | None = None,
-             done: bool = False, allowance_s: float | None = None) -> None:
+             done: bool = False, allowance_s: float | None = None,
+             sync_wait_ms: float | None = None) -> None:
         """`allowance_s` widens THIS beat's staleness window beyond the
         watchdog's (the startup beat carries the compile-sized allowance:
         a host killed during the minutes-long first compile is still
-        detected — just on the startup clock, not the steady-state one)."""
+        detected — just on the startup clock, not the steady-state one).
+        `sync_wait_ms` is the host's last log-interval device sync wall
+        time — the collectives block until the SLOWEST host, so a host
+        with a LOW sync wait next to peers with high ones is itself the
+        straggler everyone else is waiting for (straggler_table)."""
         record = {
             "process_index": self.process_index,
             "pid": os.getpid(),
@@ -275,6 +292,8 @@ class HeartbeatWriter:
         }
         if allowance_s is not None:
             record["allowance_s"] = float(allowance_s)
+        if sync_wait_ms is not None:
+            record["sync_wait_ms"] = round(float(sync_wait_ms), 3)
         path = beat_path(self.directory, self.process_index)
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
@@ -441,6 +460,60 @@ class CrossHostWatchdog:
         )
 
 
+def straggler_table(directory: str) -> dict:
+    """Per-host progress attribution off the heartbeat files: who is the
+    slowest host, and by how much — the question a wedged-but-not-dead
+    host raises BEFORE the watchdog window expires and kills the run.
+
+    Reference time is the NEWEST beat (not the wall clock), so the table
+    reads identically live and post-mortem (the harness and the chaos
+    drill read it after the processes exited). Per row: the host's last
+    step, how many steps behind the front-runner it is, how long it has
+    been silent relative to the newest beat, and its last log-interval
+    sync wait (a straggler shows a LOW sync wait while every peer's is
+    high — the peers are waiting for it in the collective). `suspect` is
+    the worst live (not-done) host, named only when it is actually behind;
+    `skew_fraction` = its deficit over the front-runner's step count."""
+    beats: list[dict] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        names = []
+    for name in names:
+        if name.startswith("host_") and name.endswith(".json"):
+            beat = read_beat(os.path.join(directory, name))
+            if beat is not None:
+                beats.append(beat)
+    if not beats:
+        return {"rows": [], "suspect": None, "skew_fraction": 0.0}
+    ref_ts = max(float(b.get("ts", 0.0)) for b in beats)
+    ref_step = max(int(b.get("step") or 0) for b in beats)
+    rows = []
+    for b in sorted(beats, key=lambda b: int(b.get("process_index", -1))):
+        step = int(b.get("step") or 0)
+        rows.append({
+            "host": int(b.get("process_index", -1)),
+            "step": b.get("step"),
+            "behind_steps": max(ref_step - step, 0),
+            "silent_s": round(
+                max(ref_ts - float(b.get("ts", ref_ts)), 0.0), 3
+            ),
+            "sync_wait_ms": b.get("sync_wait_ms"),
+            "done": bool(b.get("done")),
+        })
+    live = [r for r in rows if not r["done"]]
+    suspect = None
+    skew_fraction = 0.0
+    if live:
+        worst = max(live, key=lambda r: (r["behind_steps"], r["silent_s"]))
+        skew_fraction = round(
+            worst["behind_steps"] / max(ref_step, 1), 4
+        )
+        if worst["behind_steps"] > 0:
+            suspect = worst["host"]
+    return {"rows": rows, "suspect": suspect, "skew_fraction": skew_fraction}
+
+
 def abort_markers(directory: str) -> dict[int, dict]:
     """{process_index: marker} for every abort marker under `directory` —
     the harness/operator read side of the watchdog's verdict."""
@@ -528,8 +601,15 @@ class MultihostSurvival:
         if self.watchdog is not None:
             self.watchdog.start()
 
-    def beat(self, step: int, data_bytes: int | None = None) -> None:
-        self.writer.beat(step=step, data_bytes=data_bytes)
+    def beat(self, step: int, data_bytes: int | None = None,
+             sync_wait_ms: float | None = None) -> None:
+        self.writer.beat(step=step, data_bytes=data_bytes,
+                         sync_wait_ms=sync_wait_ms)
+
+    def stragglers(self) -> dict:
+        """The straggler table over this run's heartbeat dir — what the
+        training loop logs each interval and the drill verdict embeds."""
+        return straggler_table(self.directory)
 
     def arm_failsafe(self, seconds: float | None = None,
                      reason: str = "teardown_hang",
@@ -569,18 +649,21 @@ class MultihostSurvival:
         self._failsafe.start()
 
     def stop(self, done: bool, step: int | None = None,
-             data_bytes: int | None = None) -> None:
+             data_bytes: int | None = None,
+             sync_wait_ms: float | None = None) -> None:
         """`done=True` on clean fit completion ONLY: watchdog off, final
-        done beat (exempts this host from peers' staleness judgment).
-        `done=False` is a FAILING exit: the watchdog stays armed and the
-        failsafe deadline arms on top — a crashing host must stay
-        "silent" for peers to judge, and its own teardown must stay
-        bounded (arm_failsafe)."""
+        done beat (exempts this host from peers' staleness judgment; the
+        last sync wait rides along so a finished run's straggler table
+        keeps the attribution column). `done=False` is a FAILING exit:
+        the watchdog stays armed and the failsafe deadline arms on top —
+        a crashing host must stay "silent" for peers to judge, and its
+        own teardown must stay bounded (arm_failsafe)."""
         if done:
             if self.watchdog is not None:
                 self.watchdog.stop()
             if self._failsafe is not None:
                 self._failsafe.cancel()
-            self.writer.beat(step=step, data_bytes=data_bytes, done=True)
+            self.writer.beat(step=step, data_bytes=data_bytes, done=True,
+                             sync_wait_ms=sync_wait_ms)
         else:
             self.arm_failsafe()

@@ -599,28 +599,32 @@ class RenderEngine:
             )
         return self._adopt_entry(entry)
 
-    def _adopt_entry(self, entry):
+    def _adopt_entry(self, entry, request_id: str | None = None):
         """Make a cache value (fresh from _compress, or fetched off a
         peer's wire) device-resident, exactly like startup device_puts the
         weights: a host-numpy slab fed to a compiled executable would
         re-transfer on EVERY render. nbytes is unchanged — byte accounting
-        is a property of the representation, not of where it lives."""
+        is a property of the representation, not of where it lives.
+        `request_id` attributes the H2D transfer span to the originating
+        request (a peer-fetched adoption is real request-path work)."""
         import jax
 
-        if isinstance(entry, CompressedMPI):
-            return entry.replace_arrays({
-                name: None if a is None else jax.device_put(a)
-                for name, a in entry._arrays().items()
-            })
-        if isinstance(entry.mpi_rgb, np.ndarray):  # peer-fetched fp32 entry
-            return MPIEntry(
-                mpi_rgb=jax.device_put(entry.mpi_rgb),
-                mpi_sigma=jax.device_put(entry.mpi_sigma),
-                disparity=jax.device_put(entry.disparity),
-                k=jax.device_put(entry.k),
-                bucket=entry.bucket, nbytes=entry.nbytes,
-            )
-        return entry
+        with self.tracer.span("adopt_entry", cat="serve",
+                              request_id=request_id):
+            if isinstance(entry, CompressedMPI):
+                return entry.replace_arrays({
+                    name: None if a is None else jax.device_put(a)
+                    for name, a in entry._arrays().items()
+                })
+            if isinstance(entry.mpi_rgb, np.ndarray):  # peer-fetched fp32
+                return MPIEntry(
+                    mpi_rgb=jax.device_put(entry.mpi_rgb),
+                    mpi_sigma=jax.device_put(entry.mpi_sigma),
+                    disparity=jax.device_put(entry.disparity),
+                    k=jax.device_put(entry.k),
+                    bucket=entry.bucket, nbytes=entry.nbytes,
+                )
+            return entry
 
     def _render_inputs(self, bucket: _Bucket, entry):
         """Cache value -> (rgb, sigma, disparity, k, n_planes) fp32 render

@@ -104,7 +104,7 @@ class FakeEngine(RenderEngine):
         # partition rule by design)
         return {"params": params, "batch_stats": batch_stats}
 
-    def _adopt_entry(self, entry):
+    def _adopt_entry(self, entry, request_id: str | None = None):
         # compressed entries stay host numpy too: the fake render
         # decompresses in numpy, so device placement would only add a
         # backend dependency the fake exists to avoid

@@ -28,7 +28,17 @@ Pieces, all stdlib + injectable for deterministic tests:
                /admin/swap fan-out (a training job promotes weights into
                the whole fleet through one endpoint).
   FleetHTTPServer / main()  the stdlib HTTP surface + CLI, mirroring
-               serving/server.py.
+               serving/server.py. `python -m mine_tpu.serving.fleet trace`
+               is the offline collector front (obs/collect.py).
+
+Observability: the router owns a span ring (obs/trace.py) — every
+forwarded hop, failover retry, and swap fan-out is a span carrying the
+request's trace context (X-Request-Id + X-Parent-Span, minted here when
+the client sent none), served raw at GET /debug/trace and merged
+fleet-wide (router + every replica's ring, skew-annotated, one lane per
+process) at GET /debug/trace?request_id=. An SLO tracker (obs/slo.py)
+evaluates availability + p95 objectives over the router's own request
+families on every /metrics scrape (mine_slo_* gauges).
 
 Numerics: routing and failover never touch pixels — a fleet answer is byte
 -identical to the owning replica's answer (PARITY.md).
@@ -46,8 +56,20 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 from typing import Any, Callable
 
+from mine_tpu.obs.ledger import set_build_info
+from mine_tpu.obs.slo import SLOTracker, default_objectives
+from mine_tpu.obs.trace import (
+    PARENT_SPAN_HEADER,
+    REQUEST_ID_HEADER,
+    TRACE_TOKEN_RE,
+    Tracer,
+    new_span_id,
+    resolve_parent_span,
+    resolve_request_id,
+)
 from mine_tpu.utils.metrics import MetricsRegistry
 
 
@@ -247,12 +269,29 @@ class FleetApp:
         metrics: FleetMetrics | None = None,
         transport: Callable | None = None,
         clock: Callable[[], float] = time.monotonic,
+        trace_enabled: bool = True,
+        trace_buffer_spans: int = 4096,
+        slo_objectives: Any = None,
     ):
         if isinstance(replicas, list):
             replicas = {f"r{i}": url for i, url in enumerate(replicas)}
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.metrics = metrics if metrics is not None else FleetMetrics()
+        # router-side spans: every forwarded hop (and every failover
+        # attempt) is a span carrying the request's trace context, so the
+        # router's /debug/trace ring holds ITS half of every request tree
+        self.tracer = Tracer(enabled=trace_enabled,
+                             max_spans=trace_buffer_spans)
+        # SLO layer (obs/slo.py): availability + p95 over the router's own
+        # request families, evaluated on every /metrics scrape
+        self.slo = SLOTracker(
+            self.metrics.registry,
+            slo_objectives if slo_objectives is not None
+            else default_objectives(family_prefix="mine_fleet"),
+            clock=clock,
+        )
+        set_build_info(self.metrics.registry, backend=None)
         self.replicas = {
             name: Replica(name, url, up_after, down_after)
             for name, url in replicas.items()
@@ -355,6 +394,8 @@ class FleetApp:
         body: bytes | None,
         headers: dict[str, str],
         timeout_s: float | None = None,
+        request_id: str | None = None,
+        parent_span: str | None = None,
     ) -> tuple[int, dict[str, str], bytes, str]:
         """Route one request by digest with bounded failover.
 
@@ -365,6 +406,12 @@ class FleetApp:
         every other status, including 404/504/500, is the replica's honest
         ANSWER and passes through (re-dispatching a 404 elsewhere cannot
         find an MPI that only the owner would have had).
+
+        Trace context: every attempt (first dispatch AND each failover
+        retry) records a router span with a fresh span_id and sends the
+        replica `X-Request-Id: request_id` + `X-Parent-Span: <span_id>`,
+        so the replica's spans hang off exactly the attempt that reached
+        it and a failed attempt is visible as a childless span.
 
         Returns (status, headers, body, replica_name). Raises
         NoHealthyReplica (-> 503) or FleetDeadlineExceeded (-> 504).
@@ -395,10 +442,24 @@ class FleetApp:
                 )
             attempts += 1
             self.metrics.routed.inc(replica=replica.name)
+            span_id = new_span_id()
+            send_headers = dict(headers)
+            if request_id:
+                send_headers[REQUEST_ID_HEADER] = request_id
+                send_headers[PARENT_SPAN_HEADER] = span_id
+            span = self.tracer.span(
+                "forward", cat="fleet", request_id=request_id,
+                replica=replica.name, path=path, attempt=attempts,
+                span_id=span_id, parent_span=parent_span,
+            )
             try:
-                status, resp_headers, resp_body = self.transport(
-                    method, replica.base_url + path, body, headers, remaining
-                )
+                with span:
+                    status, resp_headers, resp_body = self.transport(
+                        method, replica.base_url + path, body, send_headers,
+                        remaining,
+                    )
+                    if hasattr(span, "args"):  # live span: the answer
+                        span.args["status"] = status
             except TimeoutError:
                 # the ATTEMPT's budget ran out, not necessarily the
                 # replica: a busy-but-healthy replica under an impatient
@@ -463,8 +524,44 @@ class FleetApp:
             },
         }
 
+    def aggregated_trace(self, request_id: str,
+                         timeout_s: float | None = None) -> dict:
+        """GET /debug/trace?request_id= across the WHOLE fleet: the
+        router's own spans for this request plus every replica's
+        /debug/trace?request_id= ring, merged into one skew-annotated
+        Chrome-trace doc with per-process lanes and the cross-process hop
+        tree in metadata (obs/collect.py). Unreachable replicas are named
+        in metadata, never silently missing."""
+        from mine_tpu.obs import collect
+
+        timeout = timeout_s if timeout_s else self.probe_timeout_s
+
+        def fetch(url: str, t: float) -> dict:
+            # ride the app's transport so tests inject fakes and the
+            # error taxonomy matches every other router-replica call
+            status, _, body = self.transport("GET", url, None, {}, t)
+            if status != 200:
+                raise RuntimeError(f"/debug/trace answered {status}")
+            return json.loads(body)
+
+        return collect.collect_fleet_trace(
+            {r.name: r.base_url for r in self.replicas.values()},
+            request_id=request_id,
+            # the router's OWN lane is filtered to the request too —
+            # replicas answer pre-filtered, and a busy router's ring
+            # holds every other request's spans, which must not leak
+            # into this request's merged doc
+            local={"name": "router", "doc": collect.filter_doc_to_request(
+                self.tracer.to_chrome_trace(), request_id
+            )},
+            timeout_s=timeout,
+            fetch_fn=fetch,
+        )
+
     def swap_all(self, wait: bool = True,
-                 timeout_s: float = 600.0) -> dict[str, dict]:
+                 timeout_s: float = 600.0,
+                 request_id: str | None = None,
+                 parent_span: str | None = None) -> dict[str, dict]:
         """Fan POST /admin/swap out to EVERY configured replica
         (sequentially: a rolling upgrade — at most one replica is warming a
         generation at a time, the rest serve). Deliberately not limited to
@@ -479,11 +576,23 @@ class FleetApp:
         results: dict[str, dict] = {}
         in_ring = set(self.ring_members())
         for name, replica in self.replicas.items():
+            span_id = new_span_id()
+            headers = {"Content-Type": "application/json"}
+            if request_id:
+                # the fan-out carries the trace context too: a rolling
+                # fleet upgrade is one request whose hops are the replicas
+                headers[REQUEST_ID_HEADER] = request_id
+                headers[PARENT_SPAN_HEADER] = span_id
+            span = self.tracer.span(
+                "swap_fanout", cat="fleet", request_id=request_id,
+                replica=name, span_id=span_id, parent_span=parent_span,
+            )
             try:
-                status, _, body = self.transport(
-                    "POST", replica.base_url + "/admin/swap", payload,
-                    {"Content-Type": "application/json"}, timeout_s,
-                )
+                with span:
+                    status, _, body = self.transport(
+                        "POST", replica.base_url + "/admin/swap", payload,
+                        headers, timeout_s,
+                    )
                 try:
                     results[name] = {"status": status, **json.loads(body)}
                 except ValueError:
@@ -544,7 +653,7 @@ class _FleetHandler(BaseHTTPRequestHandler):
     server: "FleetHTTPServer"
     protocol_version = "HTTP/1.1"
 
-    _FORWARD_HEADERS = ("Content-Type", "X-Request-Id")
+    _FORWARD_HEADERS = ("Content-Type",)
 
     def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
         if self.server.verbose:
@@ -555,6 +664,11 @@ class _FleetHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
+        rid = getattr(self, "request_id", None)
+        if rid and not (extra and REQUEST_ID_HEADER in extra):
+            # every router response names its request — the id keys the
+            # aggregated /debug/trace?request_id= lookup
+            self.send_header(REQUEST_ID_HEADER, rid)
         for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -576,9 +690,31 @@ class _FleetHandler(BaseHTTPRequestHandler):
             self._send_json(code, health)
             return code, "healthz"
         if method == "GET" and path == "/metrics":
+            # SLO gauges refresh on scrape cadence, like everything else
+            # on the page (obs/slo.py)
+            app.slo.evaluate()
             self._send(200, app.metrics.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
             return 200, "metrics"
+        if method == "GET" and path == "/debug/trace":
+            query = parse_qs(self.path.partition("?")[2])
+            rid = (query.get("request_id") or [None])[0]
+            if rid and not TRACE_TOKEN_RE.match(rid):
+                # the query-param path gets the SAME charset guard as
+                # the header path: a malformed id interpolated into K
+                # replica fetch URLs would fail every fetch and read as
+                # a fleet-wide outage instead of the client error it is
+                self._send_json(400, {
+                    "error": f"malformed request_id {rid[:64]!r}",
+                })
+                return 400, "debug_trace"
+            if rid:
+                # fleet-wide: router spans + every replica's ring for
+                # this request, merged with per-process lanes
+                self._send_json(200, app.aggregated_trace(rid))
+            else:
+                self._send_json(200, app.tracer.to_chrome_trace())
+            return 200, "debug_trace"
         if method == "POST" and path == "/admin/swap":
             body = self._read_body()
             wait = True
@@ -587,7 +723,10 @@ class _FleetHandler(BaseHTTPRequestHandler):
                     wait = bool(json.loads(body).get("wait", True))
             except ValueError:
                 pass
-            results = app.swap_all(wait=wait)
+            results = app.swap_all(
+                wait=wait, request_id=self.request_id,
+                parent_span=self._span_id,
+            )
             # with wait (the default), success means the swap RESOLVED on
             # every in-ring replica — a 202/in_progress is not a flip.
             # Out-of-ring replicas are best-effort (reported, not gating):
@@ -622,7 +761,8 @@ class _FleetHandler(BaseHTTPRequestHandler):
         }
         try:
             status, resp_headers, resp_body, replica = app.forward(
-                digest, method, path, body, headers, timeout_s=timeout_s
+                digest, method, path, body, headers, timeout_s=timeout_s,
+                request_id=self.request_id, parent_span=self._span_id,
             )
         except NoHealthyReplica as exc:
             retry_after = max(exc.retry_after_s, 0.1)
@@ -646,7 +786,20 @@ class _FleetHandler(BaseHTTPRequestHandler):
     def _handle(self, method: str) -> None:
         app = self.server.app
         path = self.path.split("?", 1)[0]
+        # trace context off the headers — the ONE resolve implementation
+        # shared with the replica server (obs/trace.py)
+        self.request_id = resolve_request_id(
+            self.headers.get(REQUEST_ID_HEADER)
+        )
+        # the router-side root of this request's span tree: forward /
+        # swap_fanout spans point at it via parent_span, and an upstream
+        # caller's X-Parent-Span (if any) becomes ITS parent
+        self._span_id = new_span_id()
+        client_parent = resolve_parent_span(
+            self.headers.get(PARENT_SPAN_HEADER)
+        )
         t0 = time.monotonic()
+        p0 = time.perf_counter()
         try:
             code, endpoint = self._route(method, path)
         except (BrokenPipeError, ConnectionResetError):
@@ -657,6 +810,15 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
             except Exception:  # noqa: BLE001 - client already gone
                 pass
+        if endpoint not in ("metrics", "healthz", "debug_trace"):
+            # scrape/introspection traffic stays out of the ring — the
+            # trace exists for routed product requests
+            app.tracer.record(
+                "request", "fleet", p0, time.perf_counter(),
+                request_id=self.request_id, endpoint=endpoint,
+                status=code, span_id=self._span_id,
+                parent_span=client_parent,
+            )
         app.metrics.requests.inc(endpoint=endpoint, status=str(code))
         app.metrics.request_latency.observe(
             time.monotonic() - t0, endpoint=endpoint
@@ -687,7 +849,86 @@ def make_fleet_server(
     return FleetHTTPServer((host, port), app, verbose=verbose)
 
 
+def _parse_members(specs: list[str]) -> dict[str, str]:
+    """--replica values (URL or NAME=URL) -> {name: url}."""
+    members: dict[str, str] = {}
+    for i, spec in enumerate(specs):
+        name, sep, url = spec.partition("=")
+        if sep and not name.startswith("http"):
+            members[name] = url
+        else:
+            members[f"r{i}"] = spec
+    return members
+
+
+def trace_main(argv: list[str]) -> None:
+    """`python -m mine_tpu.serving.fleet trace`: pull /debug/trace from
+    every member (replicas and/or the router), estimate per-member clock
+    skew from the probe round trips, and write ONE merged Chrome-trace
+    JSON with per-process lanes — openable in Perfetto or summarized by
+    tools/profile_summary.py. With --request-id, the doc is filtered to
+    that request and carries its cross-process hop tree in metadata."""
+    from mine_tpu.obs import collect
+
+    parser = argparse.ArgumentParser(
+        prog="fleet trace", description=trace_main.__doc__
+    )
+    parser.add_argument(
+        "--replica", action="append", default=[], metavar="[NAME=]URL",
+        help="member to pull /debug/trace from (repeatable); include the "
+        "router's URL to get its lane too",
+    )
+    parser.add_argument("--request-id", default=None,
+                        help="filter to one request + build its hop tree")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--out", default=None,
+                        help="write the merged trace here (default: stdout)")
+    args = parser.parse_args(argv)
+    if not args.replica:
+        parser.error("at least one --replica URL is required")
+    if args.request_id and not TRACE_TOKEN_RE.match(args.request_id):
+        parser.error(f"malformed --request-id {args.request_id[:64]!r} "
+                     "(allowed: [A-Za-z0-9._-], max 128 chars)")
+    doc = collect.collect_fleet_trace(
+        _parse_members(args.replica), request_id=args.request_id,
+        timeout_s=args.timeout,
+    )
+    meta = doc["metadata"]
+    summary = {
+        "members": {
+            name: ({"error": m["error"]} if "error" in m else {
+                "skew_s": (round(m["skew_s"], 6)
+                           if m.get("skew_s") is not None else None),
+                "rtt_s": round(m.get("rtt_s") or 0.0, 6),
+            })
+            for name, m in meta["members"].items()
+        },
+        "events": sum(1 for ev in doc["traceEvents"]
+                      if ev.get("ph") == "X"),
+    }
+    if args.request_id:
+        tree = meta.get("request_tree", {})
+        summary["request_id"] = args.request_id
+        summary["span_count"] = tree.get("span_count", 0)
+        summary["processes"] = tree.get("processes", [])
+        summary["tree_depth"] = collect.tree_depth(tree.get("tree", []))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(doc, fh)
+        summary["out"] = args.out
+        print(json.dumps(summary))
+    else:
+        print(json.dumps(doc))
+        print(json.dumps(summary), file=__import__("sys").stderr)
+
+
 def main(argv: list[str] | None = None) -> None:
+    if argv is None:
+        import sys
+
+        argv = sys.argv[1:]
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:])
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--replica", action="append", default=[], metavar="URL",
@@ -712,7 +953,7 @@ def main(argv: list[str] | None = None) -> None:
     host, port = server.server_address[:2]
     print(f"fleet router over {len(args.replica)} replicas on "
           f"http://{host}:{port} (/predict /render /healthz /metrics "
-          f"/admin/swap)")
+          f"/admin/swap /debug/trace)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -64,10 +64,8 @@ import hashlib
 import io
 import json
 import os
-import re
 import threading
 import time
-import uuid
 from urllib.parse import parse_qs
 from concurrent.futures import Future, TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -76,8 +74,17 @@ from typing import Any
 import numpy as np
 
 from mine_tpu.config import Config
+from mine_tpu.obs.ledger import set_build_info
 from mine_tpu.obs.memlog import MemLog
-from mine_tpu.obs.trace import Tracer
+from mine_tpu.obs.slo import tracker_from_config
+from mine_tpu.obs.trace import (
+    PARENT_SPAN_HEADER,
+    REQUEST_ID_HEADER,
+    Tracer,
+    new_span_id,
+    resolve_parent_span,
+    resolve_request_id,
+)
 from mine_tpu.resilience import BreakerOpen, CircuitBreaker, chaos
 from mine_tpu.serving.batcher import (
     BatcherStopped,
@@ -219,6 +226,17 @@ class ServingApp:
                 tracer=self.tracer,
             )
         self.metrics.weight_generation.set(self.engine.generation)
+        # SLO layer (obs/slo.py): availability + p95 objectives over the
+        # families this registry already counts, refreshed on scrape
+        self.slo = tracker_from_config(self.metrics.registry, cfg)
+        # mine_build_info: scrapes join perf-ledger rows on git_rev
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 - identity, never a crash
+            backend = None
+        set_build_info(self.metrics.registry, backend=backend)
         # hot-swap source: a workspace path (str — the production shape:
         # POST /admin/swap re-reads its newest checkpoint, validated
         # against the serving tree) or a zero-arg callable returning
@@ -471,7 +489,7 @@ class ServingApp:
 
     def predict(
         self, image_bytes: bytes, spec: BucketSpec | None = None,
-        request_id: str | None = None,
+        request_id: str | None = None, parent_span: str | None = None,
     ) -> dict:
         digest = hashlib.sha256(image_bytes).hexdigest()
         if spec is not None:
@@ -552,7 +570,8 @@ class ServingApp:
                 )
             # then the fleet wire: a peer holding this exact key hands us
             # the compressed MPI for network bytes instead of encoder FLOPs
-            entry = self._peer_fetch(key, digest, request_id=request_id)
+            entry = self._peer_fetch(key, digest, request_id=request_id,
+                                     parent_span=parent_span)
             from_peer = entry is not None
             if entry is None:
                 entry = self._breaker_guard(
@@ -596,14 +615,20 @@ class ServingApp:
         ring = HashRing(list(peers), vnodes=vnodes)
         self.peers, self.peer_name, self._peer_ring = dict(peers), peer_name, ring
 
-    def _peer_fetch(self, key, digest: str, request_id: str | None = None):
+    def _peer_fetch(self, key, digest: str, request_id: str | None = None,
+                    parent_span: str | None = None):
         """Try to adopt this key's compressed MPI from a MORE authoritative
         peer (every replica earlier than us in the consistent-hash
         candidate order for this digest — when we ARE the owner the list is
         empty and no network is touched; after a membership change the
         previous owner is exactly the replica before us). Returns the
         device-adopted entry or None; NEVER raises — every failure outcome
-        is a counter tick and a fallthrough to the local predict."""
+        is a counter tick and a fallthrough to the local predict.
+
+        The GET carries the originating request's trace context
+        (X-Request-Id + X-Parent-Span = this hop's span id), so the peer's
+        ring records the hop under the SAME request id — before this, the
+        peer hop was invisible to the request's merged trace."""
         # ONE consistent membership snapshot: configure_peers may swap
         # ring/peers/name under a live server (bench_fleet does), and a
         # name resolved against the old ring must not KeyError against the
@@ -641,11 +666,18 @@ class ServingApp:
                 continue
             url = f"{base_url.rstrip('/')}/mpi/{key_str}"
             outcome = "error"
+            hop_id = new_span_id()
+            hop_headers: dict[str, str] = {}
+            if request_id:
+                hop_headers[REQUEST_ID_HEADER] = request_id
+                hop_headers[PARENT_SPAN_HEADER] = hop_id
             try:
                 with self.tracer.span("peer_fetch", cat="serve", peer=name,
-                                      request_id=request_id):
+                                      request_id=request_id,
+                                      span_id=hop_id,
+                                      parent_span=parent_span):
                     status, _, body = _urllib_transport(
-                        "GET", url, None, {}, remaining
+                        "GET", url, None, hop_headers, remaining
                     )
                 if status == 200:
                     entry = from_wire(body)
@@ -676,7 +708,9 @@ class ServingApp:
                     if drifted:
                         self.metrics.peer_fetch.inc(outcome="incompatible")
                         return None
-                    entry = self.engine._adopt_entry(entry)
+                    entry = self.engine._adopt_entry(
+                        entry, request_id=request_id
+                    )
                     self.metrics.peer_fetch.inc(outcome="hit")
                     return entry
                 outcome = "miss" if status == 404 else "error"
@@ -739,21 +773,15 @@ class ServingApp:
         """One request's span tree as Chrome-trace JSON: every span whose
         args carry this request_id — the handler-side parse/predict/render/
         cache_lookup/encode spans plus the batcher/engine spans of any
-        dispatch that included it (their request_ids list)."""
-        doc = self.tracer.to_chrome_trace()
-        kept = []
-        for ev in doc["traceEvents"]:
-            if ev.get("ph") == "M":
-                kept.append(ev)
-                continue
-            args = ev.get("args") or {}
-            if args.get("request_id") == request_id:
-                kept.append(ev)
-            elif request_id in str(args.get("request_ids", "")).split(","):
-                kept.append(ev)
-        doc["traceEvents"] = kept
-        doc["metadata"]["request_id"] = request_id
-        return doc
+        dispatch that included it (their request_ids list). The matching
+        rule is obs/collect.py's — the SAME one the fleet aggregation
+        applies to the router's ring, so the two surfaces can never
+        disagree about which spans belong to a request."""
+        from mine_tpu.obs.collect import filter_doc_to_request
+
+        return filter_doc_to_request(
+            self.tracer.to_chrome_trace(), request_id
+        )
 
     def health(self) -> dict:
         import jax
@@ -888,8 +916,10 @@ class _Handler(BaseHTTPRequestHandler):
             return code, "healthz"
         if method == "GET" and path == "/metrics":
             # scrape-cadence HBM sample: the gauges stay current even when
-            # no dispatch has run since the last scrape (obs/memlog.py)
+            # no dispatch has run since the last scrape (obs/memlog.py);
+            # the SLO gauges refresh on the same cadence (obs/slo.py)
             app.memlog.sample()
+            app.slo.evaluate()
             self._send(200, app.metrics.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
             return 200, "metrics"
@@ -931,23 +961,24 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(404, {"error": f"no route {method} {path}"})
         return 404, "unknown"
 
-    # X-Request-Id charset guard: an id is echoed into a response header
-    # and span args, so a hostile value must not smuggle newlines or blow
-    # up the ring — anything outside this alphabet gets a minted id
-    _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,128}$")
-
-    def _resolve_request_id(self) -> str:
-        """The client's X-Request-Id when well-formed, else a minted one —
-        every request gets an id, so every span tree is addressable."""
-        rid = self.headers.get("X-Request-Id", "")
-        if self._REQUEST_ID_RE.match(rid):
-            return rid
-        return uuid.uuid4().hex[:16]
-
     def _handle(self, method: str) -> None:
         app = self.server.app
         path = self.path.split("?", 1)[0]
-        self.request_id = self._resolve_request_id()
+        # trace context off the headers (obs/trace.py — the ONE resolve
+        # implementation shared with the fleet router): a well-formed
+        # X-Request-Id is kept, else minted; a malformed X-Parent-Span
+        # (set by the router's forward/fan-out and a peer's fetch) drops
+        self.request_id = resolve_request_id(
+            self.headers.get(REQUEST_ID_HEADER)
+        )
+        # this request's root span id on THIS replica: downstream hops
+        # (peer fetch) point at it; the upstream hop (router forward /
+        # peer GET) is its parent — the links obs/collect.py request_tree
+        # assembles the cross-process tree from
+        self._span_id = new_span_id()
+        self._parent_span = resolve_parent_span(
+            self.headers.get(PARENT_SPAN_HEADER)
+        )
         if chaos.should("replica_kill"):  # fault seam (resilience/chaos.py)
             # replica death, as a fleet router sees it: the listener goes
             # away and the triggering connection drops with NO response —
@@ -966,6 +997,7 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
             return
         t0 = time.monotonic()
+        p0 = time.perf_counter()
         try:
             code, endpoint = self._route(method, path)
         except (BrokenPipeError, ConnectionResetError):
@@ -983,6 +1015,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
             except Exception:  # noqa: BLE001 - client already gone
                 pass
+        if endpoint not in ("metrics", "healthz", "debug_trace"):
+            # the request-root span: carries this replica's span_id (what
+            # a downstream peer fetch points at) and the upstream hop's
+            # parent — scrape traffic stays out of the ring
+            app.tracer.record(
+                "request", "serve", p0, time.perf_counter(),
+                request_id=self.request_id, endpoint=endpoint,
+                status=code, span_id=self._span_id,
+                parent_span=self._parent_span,
+            )
         app.metrics.requests.inc(endpoint=endpoint, status=str(code))
         app.metrics.request_latency.observe(
             time.monotonic() - t0, endpoint=endpoint
@@ -1019,7 +1061,8 @@ class _Handler(BaseHTTPRequestHandler):
             return 400
         try:
             with app.tracer.span("predict", cat="serve", request_id=rid):
-                result = app.predict(image_bytes, spec, request_id=rid)
+                result = app.predict(image_bytes, spec, request_id=rid,
+                                     parent_span=self._span_id)
         except (BreakerOpen, RequestTimeout) as exc:
             return self._overload_response(exc)
         except (ValueError, OSError) as exc:

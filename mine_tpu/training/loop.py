@@ -151,6 +151,15 @@ class TrainObsMetrics:
         self.imgs_per_sec = r.gauge(
             "mine_train_imgs_per_sec", "global training throughput",
         )
+        self.sync_wait_ms = r.gauge(
+            "mine_train_sync_wait_ms",
+            "wall time of the log-interval device_get sync (labeled by "
+            "process_index). On multi-process runs the collectives block "
+            "until the SLOWEST host, so a host whose sync wait is LOW "
+            "while its peers' are high is the straggler everyone waits "
+            "for — the per-host distribution is the straggler-attribution "
+            "signal (resilience/multihost.py straggler_table)",
+        )
         self.grad_norm = r.gauge(
             "mine_train_grad_norm",
             "global gradient norm at the latest logged step",
@@ -279,6 +288,7 @@ class Trainer:
             cfg, self.local_dir, flight=self.flight, logger=self.logger,
         )
         self._host_bytes = 0  # host-materialized batch bytes, this process
+        self._last_sync_wait_ms: float | None = None
         self.model = build_model(cfg, **model_axes(self.mesh))
         # effective batch PER UPDATE. Accumulation splits each device's
         # batch into accum_steps micro-batches inside the step; it never
@@ -301,6 +311,14 @@ class Trainer:
         # degrades the knob to replicated), so the sidecar below records
         # what actually runs
         self.zero1 = zero1_enabled(cfg, self.mesh)
+        # mine_build_info{git_rev,jax_version,backend}: the join key that
+        # lets a scrape line up with perf-ledger rows (obs/ledger.py) —
+        # the mesh above already initialized the backend, so naming it
+        # here costs nothing
+        from mine_tpu.obs.ledger import set_build_info
+
+        set_build_info(self.obs_metrics.registry,
+                       backend=jax.default_backend())
         if jax.process_index() == 0:
             os.makedirs(self.local_dir, exist_ok=True)
             ckpt.save_paired_config(cfg, self.local_dir)
@@ -486,6 +504,7 @@ class Trainer:
             # initial compile must not trip the window); the watchdog
             # judges only files that exist (resilience/multihost.py)
             self.multihost.start()
+            self._clear_stale_host_trace_exports()
         # preemption guard AFTER the flight recorder, so its SIGTERM handler
         # chains: atomic save -> flight dump -> re-delivered termination
         guard: PreemptionGuard | None = None
@@ -544,6 +563,7 @@ class Trainer:
                 self.multihost.stop(
                     done=fit_ok, step=self._progress.get("global_step"),
                     data_bytes=self._host_bytes,
+                    sync_wait_ms=self._last_sync_wait_ms,
                 )
             if self.flight is not None:
                 self.flight.stop()
@@ -613,11 +633,43 @@ class Trainer:
             "hbm": self.memlog.last(),
         }
 
+    def _clear_stale_host_trace_exports(self) -> None:
+        """Process 0 removes the PREVIOUS run's per-process host-span
+        exports at multi-process start — exports only happen at run exit,
+        so an elastic restart at fewer hosts would otherwise merge the
+        dead 4th host's old lane into this run's timeline
+        (obs/collect.py training_timeline). Age-gated with the heartbeat
+        sweep's margin so a racing peer's late just-exited export from
+        THIS relaunch window is left alone (the bare single-process
+        filename is cleared too: it would collide with p0)."""
+        if jax.process_index() != 0:
+            return
+        import glob as glob_mod
+
+        now = time.time()
+        pattern = os.path.join(self.local_dir, "profile",
+                               "host_spans*.trace.json")
+        for path in glob_mod.glob(pattern):
+            try:
+                if (now - os.path.getmtime(path)
+                        > multihost_mod._CLEANUP_MIN_AGE_S):
+                    os.remove(path)
+            except OSError:
+                pass
+
     def _host_trace_path(self) -> str:
         """Host spans land next to the device traces (`<sidecar>/profile`)
         with a `*.trace.json` name, so tools/profile_summary.py's glob
-        picks up both halves of a run from one directory."""
-        return os.path.join(self.local_dir, "profile", "host_spans.trace.json")
+        picks up both halves of a run from one directory. Multi-process
+        runs share ONE sidecar, so each process exports its own
+        `host_spans_p<idx>.trace.json` — before this, N processes raced
+        one filename and the merged timeline lost N-1 hosts; the
+        single-process name is unchanged (existing tooling globs)."""
+        if jax.process_count() > 1:
+            name = f"host_spans_p{jax.process_index()}.trace.json"
+        else:
+            name = "host_spans.trace.json"
+        return os.path.join(self.local_dir, "profile", name)
 
     def _export_host_trace(self) -> None:
         if not self.tracer.enabled or not len(self.tracer):
@@ -938,7 +990,13 @@ class Trainer:
 
                 if step_in_epoch % cfg.training.log_interval == 0:
                     # one transfer for the whole dict: per-key float() would
-                    # block on a device sync PER KEY per log step
+                    # block on a device sync PER KEY per log step. The wall
+                    # time of this block IS the sync wait: it blocks until
+                    # every in-flight collective resolves, i.e. until the
+                    # slowest host — measured unconditionally (the tracer
+                    # may be off) because it feeds the straggler gauge and
+                    # the heartbeat below.
+                    t_sync0 = time.perf_counter()
                     with tracer.span("sync", cat="train", step=global_step):
                         fetch = {k: loss_dict[k] for k in LOSS_KEYS}
                         if "grad_norm" in loss_dict:
@@ -948,6 +1006,12 @@ class Trainer:
                         host_losses = {
                             k: float(v) for k, v in host_vals.items()
                         }
+                    sync_wait_ms = (time.perf_counter() - t_sync0) * 1e3
+                    self._last_sync_wait_ms = sync_wait_ms
+                    self.obs_metrics.sync_wait_ms.set(
+                        sync_wait_ms,
+                        process_index=str(jax.process_index()),
+                    )
                     with tracer.span("log", cat="train", step=global_step):
                         for k, v in host_losses.items():
                             meters[k].update(v, cfg.training.log_interval)
@@ -984,10 +1048,30 @@ class Trainer:
                         if self.multihost is not None:
                             # cross-host heartbeat, piggybacked on the sync
                             # this block already paid for: one tiny atomic
-                            # file write per log interval
+                            # file write per log interval. The sync wait
+                            # rides along so every host can see every
+                            # OTHER host's wait — the cross-host half of
+                            # the straggler attribution.
                             self.multihost.beat(
-                                global_step, data_bytes=self._host_bytes
+                                global_step, data_bytes=self._host_bytes,
+                                sync_wait_ms=sync_wait_ms,
                             )
+                            if jax.process_index() == 0:
+                                # straggler attribution BEFORE the watchdog
+                                # has to kill anything: a wedged-but-alive
+                                # host shows up here first (N tiny file
+                                # reads per interval, process 0 only)
+                                table = self.multihost.stragglers()
+                                if (table["suspect"] is not None
+                                        and any(
+                                            r["behind_steps"] >= 2
+                                            for r in table["rows"])):
+                                    self.logger.warning(
+                                        "straggler: host %s is %s; table %s",
+                                        table["suspect"],
+                                        f"{table['skew_fraction']:.0%} behind",
+                                        table["rows"],
+                                    )
                     if tracer.enabled:
                         # AFTER the log span closes, so this interval's own
                         # sync/log phases are in the summary it publishes

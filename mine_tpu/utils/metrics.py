@@ -79,6 +79,13 @@ class Counter(_Family):
         with self.registry._lock:
             return self._children.get(self._key(labels), 0.0)
 
+    def labeled_values(self) -> dict[tuple[tuple[str, str], ...], float]:
+        """One consistent snapshot of every child: {sorted label tuple ->
+        cumulative value}. The SLO tracker (obs/slo.py) diffs two of these
+        to get a rolling-window rate without a second accounting path."""
+        with self.registry._lock:
+            return dict(self._children)
+
 
 class Gauge(_Family):
     """Settable point-in-time value (optionally labeled)."""
@@ -157,6 +164,13 @@ class Histogram(_Family):
     def sum(self, **labels: str) -> float:
         with self.registry._lock:
             return self._sum.get(self._key(labels), 0.0)
+
+    def labeled_buckets(self) -> dict[tuple, list[int]]:
+        """One consistent snapshot of every child's NON-cumulative per-
+        bucket counts (index-aligned with `self.buckets` + the +Inf slot).
+        Like Counter.labeled_values: the obs/slo.py windowing substrate."""
+        with self.registry._lock:
+            return {k: list(v) for k, v in self._bucket_counts.items()}
 
     def bucket_counts(self, **labels: str) -> dict[float, int]:
         """Upper-bound -> CUMULATIVE count (the exposition's view)."""

@@ -569,6 +569,217 @@ def test_load_for_serving_validates_against_expected_tree():
         )
 
 
+# ------------------------------------------------- distributed tracing
+
+
+def test_forward_propagates_trace_context_and_records_spans():
+    """Every forwarded attempt sends the replica X-Request-Id + a fresh
+    X-Parent-Span, and records a router span carrying the same ids — the
+    links obs/collect.py assembles the cross-process tree from."""
+    seen_headers: list[dict] = []
+
+    def transport(method, url, body, headers, timeout_s):
+        seen_headers.append(dict(headers))
+        if "r0" in url:
+            raise ConnectionError("refused")  # force one failover retry
+        return OK
+
+    app = FleetApp({"r0": "http://r0", "r1": "http://r1"},
+                   transport=transport, probe_interval_s=3600)
+    digest = next(d for d in (f"d{i}" for i in range(50))
+                  if app.candidates_for(d)[0].name == "r0")
+    status, _, _, replica = app.forward(
+        digest, "POST", "/render", b"{}", {},
+        request_id="rid-ctx-1", parent_span="root-span",
+    )
+    assert status == 200 and replica == "r1"
+    assert len(seen_headers) == 2  # r0 attempt + r1 failover
+    sids = []
+    for hdr in seen_headers:
+        assert hdr["X-Request-Id"] == "rid-ctx-1"
+        sids.append(hdr["X-Parent-Span"])
+    assert len(set(sids)) == 2  # each ATTEMPT is its own hop span
+    spans = [s for s in app.tracer.snapshot() if s.name == "forward"]
+    assert len(spans) == 2  # the failed attempt is a span too
+    for span in spans:
+        assert span.args["request_id"] == "rid-ctx-1"
+        assert span.args["parent_span"] == "root-span"
+        assert span.args["span_id"] in sids
+    # the answered attempt recorded the replica's status
+    assert any(s.args.get("status") == 200 for s in spans)
+
+
+def test_aggregated_trace_filters_the_routers_own_ring():
+    """A busy router's ring holds EVERY request's spans; the aggregated
+    per-request doc must carry only the asked-for request's — other
+    requests' spans leaking in would mis-attribute their time."""
+    app, _ = _fleet({"http://r0": OK, "http://r1": OK})
+    digest = "d0"
+    app.forward(digest, "POST", "/render", b"{}", {},
+                request_id="wanted", parent_span=None)
+    app.forward(digest, "POST", "/render", b"{}", {},
+                request_id="other", parent_span=None)
+
+    def no_replicas(method, url, body, headers, timeout_s):
+        raise ConnectionError("down")  # isolate the router's own lane
+
+    from mine_tpu.obs import collect as collect_mod
+
+    doc = collect_mod.collect_fleet_trace(
+        {}, request_id="wanted",
+        local={"name": "router", "doc": collect_mod.filter_doc_to_request(
+            app.tracer.to_chrome_trace(), "wanted"
+        )},
+    )
+    xs = [ev for ev in doc["traceEvents"] if ev.get("ph") == "X"]
+    assert xs  # the wanted request's forward span is there
+    for ev in xs:
+        assert (ev.get("args") or {}).get("request_id") == "wanted"
+    # and through the real method: replicas unreachable, still filtered
+    app.transport = no_replicas
+    doc = app.aggregated_trace("wanted")
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "X":
+            assert (ev.get("args") or {}).get("request_id") == "wanted"
+    assert all("error" in m for n, m in
+               doc["metadata"]["members"].items() if n != "router")
+
+
+def test_router_debug_trace_rejects_malformed_request_id():
+    """The query-param path gets the header path's charset guard: a
+    malformed id is the CLIENT's 400, not K failed replica fetches
+    reading as a fleet-wide outage."""
+    import urllib.error
+    import urllib.request
+
+    from mine_tpu.serving.fleet import make_fleet_server
+
+    fleet = FleetApp({"r0": "http://127.0.0.1:1"}, probe_interval_s=3600)
+    server = make_fleet_server(fleet)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/debug/trace?request_id=a%20b%0ac"
+            )
+        assert err.value.code == 400
+        assert "malformed request_id" in err.value.read().decode()
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.close()
+
+
+def test_router_debug_trace_merges_request_across_three_processes():
+    """THE acceptance path: one /predict through router -> non-owner
+    replica -> peer-fetch GET /mpi/<key> on the (ring-ejected, still
+    alive) owner yields ONE merged trace from the router's
+    /debug/trace?request_id= whose hop tree crosses all three processes
+    under one request id. FakeEngine fleet, live HTTP, zero compiles."""
+    import hashlib
+    import urllib.request
+
+    from mine_tpu.obs import collect
+    from mine_tpu.serving.server import make_server
+
+    apps, servers, urls = [], [], {}
+    fleet = fleet_srv = None
+    try:
+        for i in range(2):
+            app = make_fake_app()
+            srv = make_server(app)
+            host, port = srv.server_address[:2]
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            apps.append(app)
+            servers.append(srv)
+            urls[f"r{i}"] = f"http://{host}:{port}"
+        for i, app in enumerate(apps):
+            app.configure_peers(urls, f"r{i}")
+        png = _png(7)
+        digest = hashlib.sha256(png).hexdigest()
+        owner = HashRing(list(urls)).candidates(digest)[0]
+        non_owner = next(n for n in urls if n != owner)
+        # seed the owner's cache directly (its own predict)
+        req = urllib.request.Request(
+            urls[owner] + "/predict", data=png,
+            headers={"Content-Type": "image/png"},
+        )
+        assert urllib.request.urlopen(req).status == 200
+        # router knows the FULL membership, but the owner is ejected from
+        # the ring (what the health gate does to a shedding replica) —
+        # its arc lands on the non-owner, which must peer-fetch
+        fleet = FleetApp(urls, probe_interval_s=3600)
+        rep = fleet.replicas[owner]
+        fleet._observe(rep, False)
+        fleet._observe(rep, False)
+        assert fleet.ring_members() == [non_owner]
+        fleet_srv = __import__(
+            "mine_tpu.serving.fleet", fromlist=["make_fleet_server"]
+        ).make_fleet_server(fleet)
+        fh, fp = fleet_srv.server_address[:2]
+        threading.Thread(target=fleet_srv.serve_forever,
+                         daemon=True).start()
+        rid = "req-accept-trace-1"
+        req = urllib.request.Request(
+            f"http://{fh}:{fp}/predict", data=png,
+            headers={"Content-Type": "image/png", "X-Request-Id": rid},
+        )
+        resp = urllib.request.urlopen(req)
+        body = json.loads(resp.read())
+        assert resp.headers["X-Request-Id"] == rid
+        assert body["cached"] is True  # adopted off the owner's wire
+        assert apps[int(non_owner[1])].metrics.peer_fetch.value(
+            outcome="hit") == 1
+        # ONE merged trace, from the ROUTER's aggregated endpoint
+        req = urllib.request.Request(
+            f"http://{fh}:{fp}/debug/trace?request_id={rid}"
+        )
+        doc = json.loads(urllib.request.urlopen(req).read())
+        members = doc["metadata"]["members"]
+        assert set(members) == {"router", "r0", "r1"}
+        assert all("error" not in m for m in members.values())
+        # clock skew per member is ESTIMATED AND RECORDED (same box:
+        # tiny), not silently ignored
+        for name in ("r0", "r1"):
+            assert members[name]["skew_s"] is not None
+            assert abs(members[name]["skew_s"]) < 5.0
+        # every kept span is this request's
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "X":
+                args = ev.get("args") or {}
+                assert (args.get("request_id") == rid
+                        or rid in str(args.get("request_ids", "")))
+        tree = doc["metadata"]["request_tree"]
+        short = {p.split(" ·")[0] for p in tree["processes"]}
+        assert short == {"router", owner, non_owner}
+        # the hop chain crosses the wire twice: router -> non-owner
+        # (forward) -> owner (peer fetch)
+        assert collect.tree_depth(tree["tree"]) >= 5
+
+        def flatten(nodes):
+            for n in nodes:
+                yield n
+                yield from flatten(n["children"])
+
+        chain = {(n["process"].split(" ·")[0], n["name"])
+                 for n in flatten(tree["tree"])}
+        assert ("router", "forward") in chain
+        assert (non_owner, "peer_fetch") in chain
+        assert (owner, "request") in chain  # the owner's /mpi hop
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        if fleet_srv is not None:
+            fleet_srv.shutdown()
+            fleet_srv.server_close()
+        if fleet is not None:
+            fleet.close()
+        for app in apps:
+            app.close()
+
+
 # ------------------------------------------------------- bench_fleet smoke
 
 
@@ -601,6 +812,10 @@ def test_bench_fleet_run_quotes_p95_and_concentration():
     assert proof["ok"], proof
     assert proof["encoder_invocations_after"] == 4
     assert proof["peer_fetch_hits"] > 0
+    # the SLO verdict over the measured window rides every bench JSON
+    assert result["slo"]["ok"], result["slo"]
+    assert set(result["slo"]["objectives"]) == {"availability",
+                                                "latency_p95"}
 
 
 # -------------------------------------------- the drill's fleet half (smoke)
@@ -628,3 +843,12 @@ def test_chaos_drill_fleet_half():
     assert result["swap_zero_5xx"]
     assert result["post_swap_key_rotated"]
     assert result["corrupt_swap_rolled_back"]
+    # each fault phase emits its own SLO verdict (availability + p95
+    # burn rate) — replica-kill stays inside budget, the swap doesn't burn
+    for phase in ("slo_kill", "slo_swap"):
+        verdict = result[phase]
+        assert verdict["ok"], verdict
+        avail = verdict["objectives"]["availability"]
+        assert avail["window_requests"] > 0  # the verdict saw the flood
+        assert avail["burn_rate"] <= 1.0
+        assert verdict["objectives"]["latency_p95"]["burn_rate"] <= 1.0

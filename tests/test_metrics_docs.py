@@ -1,0 +1,71 @@
+"""Metric-name drift guard (satellite): the README's Metrics reference
+table and the code's registered `mine_*` families must agree in BOTH
+directions — a new family without a doc row, or a stale documented row
+whose family no longer exists, fails with the names listed. Pure file
+scanning: no jax, no registries, milliseconds."""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# a family registration: .counter("mine_…") / .gauge / .histogram /
+# .summary — every metric in this codebase is registered with a literal
+# name through exactly these four MetricsRegistry constructors
+_REGISTRATION_RE = re.compile(
+    r'\.(?:counter|gauge|histogram|summary)\(\s*\n?\s*"(mine_[a-z0-9_]+)"',
+    re.S,
+)
+_TABLE_BEGIN = "<!-- metrics-reference:begin -->"
+_TABLE_END = "<!-- metrics-reference:end -->"
+_DOC_NAME_RE = re.compile(r"`(mine_[a-z0-9_]+)`")
+
+
+def _registered_families() -> set[str]:
+    names: set[str] = set()
+    for path in (REPO / "mine_tpu").rglob("*.py"):
+        names |= set(_REGISTRATION_RE.findall(path.read_text()))
+    return names
+
+
+def _documented_families() -> set[str]:
+    text = (REPO / "README.md").read_text()
+    begin = text.index(_TABLE_BEGIN)
+    end = text.index(_TABLE_END)
+    return set(_DOC_NAME_RE.findall(text[begin:end]))
+
+
+def test_readme_metrics_table_markers_exist():
+    text = (REPO / "README.md").read_text()
+    assert _TABLE_BEGIN in text and _TABLE_END in text
+    assert text.index(_TABLE_BEGIN) < text.index(_TABLE_END)
+
+
+def test_every_registered_family_is_documented():
+    undocumented = _registered_families() - _documented_families()
+    assert not undocumented, (
+        "metric families registered in code but MISSING from README's "
+        f"Metrics reference table: {sorted(undocumented)} — add a row "
+        "between the metrics-reference markers"
+    )
+
+
+def test_every_documented_family_is_registered():
+    stale = _documented_families() - _registered_families()
+    assert not stale, (
+        "README's Metrics reference table documents families no code "
+        f"registers: {sorted(stale)} — delete the stale rows (or the "
+        "registration regex in this test no longer matches the code)"
+    )
+
+
+def test_the_scan_actually_sees_the_codebase():
+    """Guard the guard: if the registration regex rots, both direction
+    checks could pass vacuously on near-empty sets."""
+    names = _registered_families()
+    assert len(names) >= 50
+    # one known family from each surface proves the scan reaches them
+    for probe in ("mine_serve_requests_total", "mine_fleet_requests_total",
+                  "mine_train_mfu", "mine_slo_burn_rate",
+                  "mine_build_info"):
+        assert probe in names

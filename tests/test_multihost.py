@@ -248,6 +248,80 @@ def test_failsafe_bounds_teardown(tmp_path):
     assert beat["done"] is True and beat["data_bytes"] == 42
 
 
+# ------------------------------------------------ heartbeat schema contract
+
+
+def test_heartbeat_schema_is_pinned(tmp_path):
+    """THE beat-file contract (satellite): the watchdog, the straggler
+    table, and the trace collector all read these files — pin the exact
+    key set so a writer/reader drift fails here with the key named, not
+    as a silently-wrong staleness or attribution verdict."""
+    d = str(tmp_path)
+    w = mh.HeartbeatWriter(d, 2, now_fn=lambda: 123.5)
+    # the constants ARE the contract; pin their spellings first
+    assert mh.BEAT_REQUIRED_KEYS == {
+        "process_index", "pid", "ts", "step", "data_bytes", "done",
+    }
+    assert mh.BEAT_OPTIONAL_KEYS == {"allowance_s", "sync_wait_ms"}
+    # a full beat: required + both optionals, nothing else
+    w.beat(step=7, data_bytes=4096, done=False, allowance_s=600.0,
+           sync_wait_ms=12.345)
+    beat = mh.read_beat(mh.beat_path(d, 2))
+    assert set(beat) == mh.BEAT_REQUIRED_KEYS | mh.BEAT_OPTIONAL_KEYS
+    assert beat["process_index"] == 2
+    assert beat["pid"] == os.getpid()
+    assert beat["ts"] == 123.5
+    assert beat["step"] == 7
+    assert beat["data_bytes"] == 4096
+    assert beat["done"] is False
+    assert beat["allowance_s"] == 600.0
+    assert beat["sync_wait_ms"] == 12.345
+    # a minimal beat: exactly the required keys (optionals truly absent,
+    # not null — read_beat consumers use .get())
+    w.beat()
+    beat = mh.read_beat(mh.beat_path(d, 2))
+    assert set(beat) == mh.BEAT_REQUIRED_KEYS
+
+
+# ------------------------------------------------- straggler attribution
+
+
+def test_straggler_table_names_slowest_live_host(tmp_path):
+    d = str(tmp_path)
+    now = [1000.0]
+    writers = {i: mh.HeartbeatWriter(d, i, now_fn=lambda: now[0])
+               for i in range(4)}
+    writers[3].beat(step=5, done=True)      # finished: exempt
+    writers[2].beat(step=2, sync_wait_ms=1.0)  # the straggler, froze here
+    now[0] += 40.0
+    writers[0].beat(step=6, sync_wait_ms=900.0)
+    writers[1].beat(step=6, sync_wait_ms=850.0)
+    table = mh.straggler_table(d)
+    assert table["suspect"] == 2
+    assert table["skew_fraction"] == pytest.approx(4 / 6, abs=1e-3)
+    rows = {r["host"]: r for r in table["rows"]}
+    assert rows[2]["behind_steps"] == 4
+    assert rows[2]["silent_s"] == pytest.approx(40.0)
+    # the straggler's own sync wait is LOW — everyone else waits for it
+    assert rows[2]["sync_wait_ms"] < rows[0]["sync_wait_ms"]
+    assert rows[3]["done"] is True
+    # the done host is never the suspect even though it is "behind"
+    assert rows[3]["behind_steps"] == 1
+
+
+def test_straggler_table_healthy_run_names_nobody(tmp_path):
+    d = str(tmp_path)
+    for i in range(3):
+        mh.HeartbeatWriter(d, i).beat(step=4)
+    table = mh.straggler_table(d)
+    assert table["suspect"] is None
+    assert table["skew_fraction"] == 0.0
+    assert len(table["rows"]) == 3
+    # and an empty/missing dir is an empty table, never a raise
+    empty = mh.straggler_table(str(tmp_path / "nope"))
+    assert empty == {"rows": [], "suspect": None, "skew_fraction": 0.0}
+
+
 # ------------------------------------------------------- host batch slicing
 
 
@@ -371,6 +445,20 @@ assert total == 90.0, total  # 15 (host 0's rows) + 75 (host 1's rows)
 w = mh.HeartbeatWriter(hb_dir, me)
 wd = mh.CrossHostWatchdog(hb_dir, me, window_s=2.0, poll_s=0.2, grace_s=0.5)
 w.beat(step=1)
+
+# per-process host-span export BEFORE the stall: the same
+# host_spans_p<idx>.trace.json layout the Trainer writes on multi-process
+# runs, so the test can merge BOTH processes' rings into one timeline
+# (obs/collect.py training_timeline) after they exit
+from mine_tpu.obs.trace import Tracer
+t = Tracer(enabled=True)
+with t.span("step", cat="train", step=1):
+    time.sleep(0.002)
+with t.span("sync", cat="train", step=1):
+    pass
+profile_dir = os.path.join(os.path.dirname(hb_dir.rstrip("/")), "profile")
+t.export(os.path.join(profile_dir, f"host_spans_p{{me}}.trace.json"))
+
 wd.start()
 print("SMOKE_READY", flush=True)
 if role == "healthy":
@@ -393,7 +481,9 @@ def test_two_process_smoke_bringup_slice_and_watchdog(tmp_path):
 
     driver = tmp_path / "smoke_driver.py"
     driver.write_text(_SMOKE_DRIVER.format(repo=REPO))
-    hb = str(tmp_path / "hb")
+    # the sidecar shape the Trainer uses: heartbeats/ + profile/ siblings,
+    # so the trace-merge below reads the REAL layout
+    hb = str(tmp_path / "heartbeats")
     os.makedirs(hb)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -437,6 +527,25 @@ def test_two_process_smoke_bringup_slice_and_watchdog(tmp_path):
     assert stall_markers and all(
         m["suspect"] == 1 for m in stall_markers
     )
+    # THE 2-process trace-merge assertion (rides this smoke instead of a
+    # new subprocess fixture): both processes exported their span rings
+    # as host_spans_p<idx>.trace.json; the collector merges them into ONE
+    # timeline with a lane per host plus the heartbeat-derived straggler
+    # attribution — which names the silent host
+    from mine_tpu.obs import collect
+
+    timeline = collect.training_timeline(str(tmp_path))
+    assert set(timeline["per_host"]) == {0, 1}
+    for idx in (0, 1):
+        assert timeline["per_host"][idx]["step"]["count"] >= 1
+        assert timeline["per_host"][idx]["sync_wait"]["count"] >= 1
+    members = timeline["doc"]["metadata"]["members"]
+    assert set(members) == {"p0", "p1"}
+    assert members["p0"]["pid"] != members["p1"]["pid"]
+    stragglers = timeline["stragglers"]
+    assert stragglers["suspect"] == 1  # the silent host, by name
+    rows = {r["host"]: r for r in stragglers["rows"]}
+    assert rows[1]["behind_steps"] >= 1
 
 
 # --------------------------------------------------------------- slow tests

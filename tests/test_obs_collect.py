@@ -1,0 +1,315 @@
+"""Cross-process trace collection (obs/collect.py): clock-anchored
+exports, skew estimation, the per-process-lane merge, per-request hop
+trees, and the multi-host training timeline — all on synthetic rings, no
+sockets, no compiles."""
+
+import json
+import os
+import time
+
+import pytest
+
+from mine_tpu.obs import collect
+from mine_tpu.obs.trace import Tracer
+
+
+def _doc_with_spans(*names, cat="host", **args):
+    t = Tracer(enabled=True)
+    for name in names:
+        with t.span(name, cat=cat, **args):
+            pass
+    return t.to_chrome_trace()
+
+
+def test_export_carries_clock_anchor():
+    doc = _doc_with_spans("a")
+    clock = doc["metadata"]["clock"]
+    assert clock["exported_unix_s"] == pytest.approx(time.time(), abs=5.0)
+    assert clock["exported_ts_us"] >= 0
+
+
+def test_fetch_member_trace_estimates_skew_from_probe_midpoint():
+    doc = _doc_with_spans("a")
+    # the member's wall clock runs 3s AHEAD of the collector's
+    doc["metadata"]["clock"]["exported_unix_s"] = 1000.0 + 3.0
+    clock = iter([999.9, 1000.1])  # probe brackets the export instant
+
+    member = collect.fetch_member_trace(
+        "r0", "http://x", fetch_fn=lambda url, t: doc,
+        now_fn=lambda: next(clock),
+    )
+    assert member["skew_s"] == pytest.approx(3.0)
+    assert member["rtt_s"] == pytest.approx(0.2)
+
+
+def test_fetch_member_trace_unreachable_is_named_not_raised():
+    def dead(url, t):
+        raise ConnectionError("refused")
+
+    member = collect.fetch_member_trace("r1", "http://x", fetch_fn=dead)
+    assert "doc" not in member
+    assert "ConnectionError" in member["error"]
+
+
+def test_merge_gives_each_member_its_own_lane_and_rebases_time():
+    doc_a = _doc_with_spans("alpha")
+    doc_b = _doc_with_spans("beta")
+    # pin both anchors so the rebase is deterministic: member b's ring
+    # started 2 (wall) seconds after a's, and b's clock is 1s fast
+    for doc, unix in ((doc_a, 1000.0), (doc_b, 1003.0)):
+        doc["metadata"]["clock"]["exported_unix_s"] = unix
+        doc["metadata"]["clock"]["exported_ts_us"] = 0.0
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "X":
+                ev["ts"] = 0.0
+    merged = collect.merge_member_traces([
+        {"name": "a", "doc": doc_a, "skew_s": 0.0},
+        {"name": "b", "doc": doc_b, "skew_s": 1.0},
+    ])
+    meta = merged["metadata"]
+    assert meta["producer"] == collect.MERGED_PRODUCER
+    assert meta["members"]["a"]["pid"] != meta["members"]["b"]["pid"]
+    assert meta["members"]["b"]["skew_s"] == 1.0
+    lanes = {
+        (ev.get("args") or {}).get("name")
+        for ev in merged["traceEvents"]
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    assert lanes == {"a · mine_tpu host spans", "b · mine_tpu host spans"}
+    ts = {
+        ev["pid"]: ev["ts"] for ev in merged["traceEvents"]
+        if ev.get("ph") == "X"
+    }
+    # a's span is the epoch; b's lands 2s later (3s wall minus 1s skew)
+    assert ts[meta["members"]["a"]["pid"]] == pytest.approx(0.0)
+    assert ts[meta["members"]["b"]["pid"]] == pytest.approx(2e6, rel=1e-6)
+
+
+def test_merge_records_unreachable_members():
+    merged = collect.merge_member_traces([
+        {"name": "a", "doc": _doc_with_spans("x")},
+        {"name": "dead", "error": "ConnectionError: refused"},
+    ])
+    assert "error" in merged["metadata"]["members"]["dead"]
+    assert merged["metadata"]["members"]["a"]["pid"] == 1
+
+
+def test_request_tree_assembles_cross_process_hops():
+    t_router = Tracer(enabled=True)
+    with t_router.span("request", cat="fleet", request_id="rid",
+                       span_id="R", parent_span=None):
+        with t_router.span("forward", cat="fleet", request_id="rid",
+                           span_id="F", parent_span="R"):
+            pass
+    t_replica = Tracer(enabled=True)
+    with t_replica.span("request", cat="serve", request_id="rid",
+                        span_id="P", parent_span="F"):
+        pass
+    with t_replica.span("engine_predict", cat="serve", request_id="rid"):
+        pass  # request-scoped but NOT a hop: stays out of the tree
+    with t_replica.span("request", cat="serve", request_id="other",
+                        span_id="Z", parent_span="F"):
+        pass  # a DIFFERENT request: filtered out entirely
+    merged = collect.merge_member_traces([
+        {"name": "router", "doc": t_router.to_chrome_trace()},
+        {"name": "r0", "doc": t_replica.to_chrome_trace()},
+    ])
+    tree = collect.request_tree(merged, "rid")
+    assert tree["span_count"] == 4  # incl. the non-hop engine span
+    assert len(tree["processes"]) == 2
+    assert collect.tree_depth(tree["tree"]) == 3
+    root = tree["tree"][0]
+    assert (root["span_id"], root["name"]) == ("R", "request")
+    fwd = root["children"][0]
+    assert fwd["span_id"] == "F"
+    assert fwd["children"][0]["process"].startswith("r0")
+
+
+def test_filter_doc_to_request_drops_foreign_spans():
+    t = Tracer(enabled=True)
+    with t.span("request", cat="fleet", request_id="mine", span_id="A"):
+        pass
+    with t.span("request", cat="fleet", request_id="other", span_id="B"):
+        pass
+    with t.span("coalesce", cat="serve", request_ids="x,mine,y"):
+        pass
+    doc = collect.filter_doc_to_request(t.to_chrome_trace(), "mine")
+    xs = [ev for ev in doc["traceEvents"] if ev.get("ph") == "X"]
+    assert {ev["name"] for ev in xs} == {"request", "coalesce"}
+    assert all(
+        (ev.get("args") or {}).get("request_id") == "mine"
+        or "mine" in str((ev.get("args") or {}).get("request_ids", ""))
+        for ev in xs
+    )
+    assert doc["metadata"]["request_id"] == "mine"
+
+
+def test_fetch_member_trace_urlencodes_the_request_id():
+    urls = []
+
+    def capture(url, t):
+        urls.append(url)
+        return _doc_with_spans("a")
+
+    collect.fetch_member_trace("r0", "http://x", request_id="a b&c",
+                               fetch_fn=capture)
+    assert urls == ["http://x/debug/trace?request_id=a%20b%26c"]
+
+
+def test_exploded_merged_doc_carries_the_outer_skew():
+    """One skew was measured for the whole fetched merged doc; every
+    inner lane must inherit it — exploded lanes landing uncorrected next
+    to directly-fetched ones would break the interleaving the merge
+    exists to show."""
+    inner = collect.merge_member_traces([
+        {"name": "r0", "doc": _doc_with_spans("alpha"), "skew_s": 0.0},
+    ])
+    merged = collect.merge_member_traces([
+        {"name": "agg", "doc": inner, "skew_s": 2.0, "rtt_s": 0.01},
+    ])
+    member = merged["metadata"]["members"]["r0"]
+    assert member["skew_s"] == 2.0
+    assert member["rtt_s"] == 0.01
+
+
+def test_merging_an_already_merged_doc_explodes_lanes_and_dedupes():
+    """Feeding the router's AGGREGATED /debug/trace?request_id= back into
+    a merge (the fleet CLI with the router as a member) must restore the
+    inner lanes, not collapse them onto one pid — and a replica fetched
+    BOTH directly and inside the merged doc must not double-count."""
+    doc_a = _doc_with_spans("alpha")
+    doc_b = _doc_with_spans("beta")
+    merged1 = collect.merge_member_traces([
+        {"name": "router", "doc": doc_a, "skew_s": 0.0},
+        {"name": "r0", "doc": doc_b, "skew_s": 0.0},
+    ])
+    merged2 = collect.merge_member_traces([
+        {"name": "agg", "doc": merged1},          # the router's answer
+        {"name": "r0", "doc": doc_b, "skew_s": 0.0},  # direct fetch too
+    ])
+    members = merged2["metadata"]["members"]
+    assert set(members) == {"router", "r0"}
+    lanes = {
+        (ev.get("args") or {}).get("name")
+        for ev in merged2["traceEvents"]
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    # inner lane names restored, NOT "agg · router · …" prefix stacking
+    assert lanes == {"router · mine_tpu host spans",
+                     "r0 · mine_tpu host spans"}
+    # r0's span appears exactly once (direct fetch won the dedupe)
+    betas = [ev for ev in merged2["traceEvents"]
+             if ev.get("ph") == "X" and ev["name"] == "beta"]
+    assert len(betas) == 1
+
+
+def test_request_tree_orphan_parent_becomes_root_not_dropped():
+    t = Tracer(enabled=True)
+    with t.span("request", cat="serve", request_id="rid",
+                span_id="P", parent_span="GONE"):  # upstream ring dropped
+        pass
+    tree = collect.request_tree(t.to_chrome_trace(), "rid")
+    assert len(tree["tree"]) == 1
+    assert tree["tree"][0]["span_id"] == "P"
+
+
+def _write_host_export(profile_dir, idx, multi=True):
+    t = Tracer(enabled=True)
+    for step in range(3):
+        with t.span("step", cat="train", step=step):
+            time.sleep(0.001 * (idx + 1))  # host 1 is slower
+        with t.span("sync", cat="train", step=step):
+            pass
+    name = (f"host_spans_p{idx}.trace.json" if multi
+            else "host_spans.trace.json")
+    return t.export(os.path.join(profile_dir, name))
+
+
+def test_training_timeline_merges_hosts_and_attributes(tmp_path):
+    sidecar = str(tmp_path)
+    profile = os.path.join(sidecar, "profile")
+    os.makedirs(profile)
+    _write_host_export(profile, 0)
+    _write_host_export(profile, 1)
+    # heartbeats: host 1 froze at step 1 while host 0 reached step 3
+    from mine_tpu.resilience.multihost import HeartbeatWriter
+
+    hb = os.path.join(sidecar, "heartbeats")
+    now = [500.0]
+    w0 = HeartbeatWriter(hb, 0, now_fn=lambda: now[0])
+    w1 = HeartbeatWriter(hb, 1, now_fn=lambda: now[0])
+    w1.beat(step=1, sync_wait_ms=2.0)
+    now[0] += 30.0
+    w0.beat(step=3, sync_wait_ms=250.0)
+
+    out = collect.training_timeline(sidecar)
+    assert set(out["per_host"]) == {0, 1}
+    for idx in (0, 1):
+        assert out["per_host"][idx]["step"]["count"] == 3
+        assert out["per_host"][idx]["sync_wait"]["count"] == 3
+    # host 1's steps are slower in the merged distributions
+    assert (out["per_host"][1]["step"]["mean_ms"]
+            > out["per_host"][0]["step"]["mean_ms"])
+    members = out["doc"]["metadata"]["members"]
+    assert set(members) == {"p0", "p1"}
+    stragglers = out["stragglers"]
+    assert stragglers["suspect"] == 1
+    assert stragglers["skew_fraction"] == pytest.approx(2 / 3, abs=1e-3)
+    row1 = next(r for r in stragglers["rows"] if r["host"] == 1)
+    assert row1["behind_steps"] == 2
+    assert row1["silent_s"] == pytest.approx(30.0)
+    assert row1["sync_wait_ms"] == 2.0
+
+
+def test_training_timeline_prefers_per_process_exports(tmp_path):
+    """A previous single-process run's bare host_spans.trace.json next to
+    a multi-process run's _p files must not collide with p0."""
+    sidecar = str(tmp_path)
+    profile = os.path.join(sidecar, "profile")
+    os.makedirs(profile)
+    stale = Tracer(enabled=True)
+    for _ in range(7):  # distinctly more spans than the fresh exports
+        with stale.span("step", cat="train"):
+            pass
+    stale.export(os.path.join(profile, "host_spans.trace.json"))
+    _write_host_export(profile, 0)
+    _write_host_export(profile, 1)
+    out = collect.training_timeline(sidecar)
+    assert set(out["per_host"]) == {0, 1}
+    assert out["per_host"][0]["step"]["count"] == 3  # NOT the stale 7
+
+
+def test_training_timeline_without_exports_raises_named(tmp_path):
+    with pytest.raises(FileNotFoundError, match="host_spans"):
+        collect.training_timeline(str(tmp_path))
+
+
+def test_profile_summary_learns_merged_multiprocess_layout(tmp_path):
+    """Satellite: tools/profile_summary.py on a MERGED trace — rows are
+    member-qualified, and the missing device lane is the expected shape
+    (no --allow-partial needed), not a half-profile error."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "profile_summary",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "profile_summary.py"),
+    )
+    ps = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ps)
+
+    merged = collect.merge_member_traces([
+        {"name": "r0", "doc": _doc_with_spans("predict", "encode")},
+        {"name": "r1", "doc": _doc_with_spans("predict")},
+    ])
+    out_dir = tmp_path / "merged_profile"
+    out_dir.mkdir()
+    with open(out_dir / "fleet_merged.trace.json", "w") as fh:
+        json.dump(merged, fh)
+    table = ps.summarize(str(out_dir))
+    assert len(table["host_lanes"]) == 2
+    ops = {row["op"] for row in table["rows"] if row["lane"] == "host"}
+    assert {"r0: predict", "r0: encode", "r1: predict"} <= ops
+    assert "note" in table
+    # a merged host-only timeline passes the lane check without escape
+    assert ps._lane_error(table, str(out_dir)) is None

@@ -1,0 +1,238 @@
+"""SLO/error-budget layer (obs/slo.py): objective math, rolling windows,
+error-status semantics, and the gauges on the live serving/fleet /metrics
+surfaces — all compile-free (registries + FakeEngine)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from mine_tpu.obs.slo import (
+    Objective,
+    SLOTracker,
+    default_objectives,
+    tracker_from_config,
+)
+from mine_tpu.utils.metrics import MetricsRegistry
+
+
+def _registry_with_requests():
+    r = MetricsRegistry()
+    requests = r.counter("mine_serve_requests_total", "t")
+    latency = r.histogram("mine_serve_request_latency_seconds", "t")
+    return r, requests, latency
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="unknown kind"):
+        Objective(name="x", kind="throughput", family="f", target=0.9)
+    with pytest.raises(ValueError, match="target"):
+        Objective(name="x", kind="availability", family="f", target=0.0)
+    with pytest.raises(ValueError, match="threshold_s"):
+        Objective(name="x", kind="latency", family="f", target=0.95)
+    with pytest.raises(ValueError, match="at least one objective"):
+        SLOTracker(MetricsRegistry(), ())
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOTracker(MetricsRegistry(), default_objectives()
+                   + default_objectives())
+
+
+def test_availability_counts_unplanned_5xx_not_exempt_503():
+    r, requests, _ = _registry_with_requests()
+    tracker = SLOTracker(r, [Objective(
+        name="avail", kind="availability",
+        family="mine_serve_requests_total", target=0.9,
+    )], clock=lambda: 0.0)
+    for _ in range(8):
+        requests.inc(endpoint="render", status="200")
+    requests.inc(endpoint="render", status="503")  # shedding: exempt
+    requests.inc(endpoint="render", status="500")  # unplanned: burns
+    # scrape endpoints never count toward availability
+    requests.inc(endpoint="metrics", status="500")
+    v = tracker.evaluate()["avail"]
+    assert v["window_requests"] == 10
+    assert v["compliance"] == pytest.approx(0.9)
+    assert v["burn_rate"] == pytest.approx(1.0)  # exactly at budget
+    assert v["ok"]
+    requests.inc(endpoint="render", status="502")
+    v = tracker.evaluate()["avail"]
+    assert v["burn_rate"] > 1.0 and not v["ok"]
+    assert v["error_budget_remaining"] < 0  # honest, not clamped
+
+
+def test_availability_exempt_statuses_configurable():
+    r, requests, _ = _registry_with_requests()
+    tracker = SLOTracker(r, [Objective(
+        name="strict", kind="availability",
+        family="mine_serve_requests_total", target=0.9,
+        exempt_statuses=(),
+    )], clock=lambda: 0.0)
+    requests.inc(endpoint="render", status="200")
+    requests.inc(endpoint="render", status="503")
+    v = tracker.evaluate()["strict"]
+    assert v["compliance"] == pytest.approx(0.5)  # shedding burns here
+
+
+def test_latency_compliance_interpolates_threshold():
+    r, _, latency = _registry_with_requests()
+    tracker = SLOTracker(r, [Objective(
+        name="p95", kind="latency",
+        family="mine_serve_request_latency_seconds", target=0.95,
+        threshold_s=0.5,
+    )], clock=lambda: 0.0)
+    for _ in range(19):
+        latency.observe(0.01, endpoint="render")  # well under
+    latency.observe(30.0, endpoint="render")      # way over
+    v = tracker.evaluate()["p95"]
+    assert v["window_requests"] == 20
+    assert v["compliance"] == pytest.approx(0.95)
+    assert v["ok"]
+    latency.observe(30.0, endpoint="render")
+    v = tracker.evaluate()["p95"]
+    assert not v["ok"]
+
+
+def test_latency_threshold_beyond_buckets_does_not_vacuously_pass():
+    """A threshold past the last finite bucket edge must not count the
+    +Inf bucket as compliant — an unbounded-slow request is never
+    'within' any threshold."""
+    r, _, latency = _registry_with_requests()
+    tracker = SLOTracker(r, [Objective(
+        name="p95", kind="latency",
+        family="mine_serve_request_latency_seconds", target=0.95,
+        threshold_s=100.0,  # DEFAULT_BUCKETS top out at 60s
+    )], clock=lambda: 0.0)
+    for _ in range(10):
+        latency.observe(600.0, endpoint="render")  # all in the +Inf slot
+    v = tracker.evaluate()["p95"]
+    assert v["compliance"] == 0.0 and not v["ok"]
+    # fast traffic still counts as good (provably <= the last edge)
+    for _ in range(990):
+        latency.observe(0.01, endpoint="render")
+    v = tracker.evaluate()["p95"]
+    assert v["compliance"] == pytest.approx(0.99)
+    assert v["ok"]
+
+
+def test_rolling_window_ages_out_old_errors():
+    r, requests, _ = _registry_with_requests()
+    clock = {"t": 0.0}
+    tracker = SLOTracker(r, [Objective(
+        name="avail", kind="availability",
+        family="mine_serve_requests_total", target=0.9, window_s=60.0,
+    )], clock=lambda: clock["t"])
+    requests.inc(endpoint="render", status="500")
+    requests.inc(endpoint="render", status="200")
+    assert not tracker.evaluate()["avail"]["ok"]
+    # the bad minute scrolls out of the window; fresh traffic is clean
+    for step in range(1, 8):
+        clock["t"] = step * 20.0
+        for _ in range(5):
+            requests.inc(endpoint="render", status="200")
+        v = tracker.evaluate()["avail"]
+    assert v["ok"] and v["compliance"] == 1.0
+
+
+def test_empty_window_is_vacuous_pass():
+    r, _, _ = _registry_with_requests()
+    tracker = SLOTracker(r, default_objectives(), clock=lambda: 0.0)
+    v = tracker.verdict()
+    assert v["ok"]
+    for obj in v["objectives"].values():
+        assert obj["compliance"] == 1.0 and obj["burn_rate"] == 0.0
+        assert obj["window_requests"] == 0
+
+
+def test_baseline_at_construction_scopes_the_window():
+    """A tracker built mid-run must judge only traffic AFTER its birth —
+    the chaos drill's per-phase verdicts depend on exactly this."""
+    r, requests, _ = _registry_with_requests()
+    for _ in range(50):
+        requests.inc(endpoint="render", status="500")  # a terrible past
+    tracker = SLOTracker(r, [Objective(
+        name="avail", kind="availability",
+        family="mine_serve_requests_total", target=0.9,
+    )], clock=lambda: 0.0)
+    for _ in range(10):
+        requests.inc(endpoint="render", status="200")
+    v = tracker.evaluate()["avail"]
+    assert v["window_requests"] == 10
+    assert v["compliance"] == 1.0 and v["ok"]
+
+
+def test_tracker_from_config_reads_serving_knobs():
+    from mine_tpu.config import Config
+
+    cfg = Config().replace(**{
+        "serving.slo_availability_target": 0.99,
+        "serving.slo_p95_ms": 1500.0,
+        "serving.slo_window_s": 120.0,
+    })
+    r, _, _ = _registry_with_requests()
+    tracker = tracker_from_config(r, cfg)
+    by_name = {o.name: o for o in tracker.objectives}
+    assert by_name["availability"].target == 0.99
+    assert by_name["latency_p95"].threshold_s == pytest.approx(1.5)
+    assert all(o.window_s == 120.0 for o in tracker.objectives)
+
+
+def test_slo_gauges_on_live_serving_metrics_scrape():
+    """A FakeEngine replica's /metrics scrape publishes the three
+    mine_slo_* gauges, refreshed per scrape (server.py wiring)."""
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.server import make_server
+
+    app = make_fake_app()
+    server = make_server(app)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        base = f"http://{host}:{port}"
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            text = resp.read().decode()
+        for family in ("mine_slo_compliance", "mine_slo_burn_rate",
+                       "mine_slo_error_budget_remaining"):
+            assert f'{family}{{slo="availability"}}' in text
+            assert f'{family}{{slo="latency_p95"}}' in text
+        # the build-info join key rides the same page (satellite)
+        assert "mine_build_info{" in text
+        assert 'jax_version="' in text and 'git_rev="' in text
+    finally:
+        server.shutdown()
+        server.server_close()
+        app.close()
+
+
+def test_slo_gauges_on_router_metrics_scrape():
+    from mine_tpu.serving.fleet import FleetApp, make_fleet_server
+
+    fleet = FleetApp({"r0": "http://127.0.0.1:1"}, probe_interval_s=3600)
+    server = make_fleet_server(fleet)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics"
+        ) as resp:
+            text = resp.read().decode()
+        assert 'mine_slo_compliance{slo="availability"}' in text
+        assert 'mine_slo_burn_rate{slo="latency_p95"}' in text
+        # the router never initializes a backend for its label
+        assert 'backend="none"' in text
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.close()
+
+
+def test_slo_latency_family_type_mismatch_is_named():
+    r, _, _ = _registry_with_requests()
+    # the construction-time baseline snapshot already reduces the family,
+    # so a mis-typed objective fails FAST and named, not at first scrape
+    with pytest.raises(TypeError, match="needs a histogram"):
+        SLOTracker(r, [Objective(
+            name="bad", kind="latency",
+            family="mine_serve_requests_total",  # counter, not histogram
+            target=0.95, threshold_s=1.0,
+        )], clock=lambda: 0.0)

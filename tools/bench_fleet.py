@@ -264,6 +264,15 @@ def run(
                         else:
                             uncached[0] += 1
 
+        # SLO verdict over the measured window (obs/slo.py): a fresh
+        # tracker's construction-time baseline scopes it to exactly the
+        # traffic below — the bench then quotes availability + p95 burn
+        # rate next to the throughput it measured them under
+        from mine_tpu.obs.slo import SLOTracker, default_objectives
+
+        slo = SLOTracker(fleet.metrics.registry, default_objectives(
+            family_prefix="mine_fleet", p95_s=5.0,
+        ))
         clients = [threading.Thread(target=client)
                    for _ in range(concurrency)]
         t0 = time.perf_counter()
@@ -272,6 +281,7 @@ def run(
         for c in clients:
             c.join(timeout=600)
         elapsed = time.perf_counter() - t0
+        slo_verdict = slo.verdict()
         if errors:
             raise RuntimeError(
                 f"{len(errors)}/{requests} fleet requests failed: {errors[0]}"
@@ -332,6 +342,10 @@ def run(
                 (1 << 30) / max(float(np.mean(entry_bytes)), 1.0), 1),
             "planes_kept_mean": round(float(np.mean(planes_kept)), 2),
             "per_replica": per_replica,
+            # burn-rate verdict for the replayed trace: availability +
+            # p95 over the measured window, from the router's own SLO
+            # tracker (the gauges a live router publishes per scrape)
+            "slo": slo_verdict,
             "failovers": _metric_value(
                 fleet_text, "mine_fleet_failovers_total"),
             "note": (

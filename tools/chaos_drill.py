@@ -396,6 +396,7 @@ def fleet_half(timeout_s: float) -> dict:
     import numpy as np
     from PIL import Image
 
+    from mine_tpu.obs.slo import SLOTracker, default_objectives
     from mine_tpu.resilience import chaos
     from mine_tpu.serving.fake import fake_checkpoint, make_fake_app
     from mine_tpu.serving.fleet import FleetApp, make_fleet_server
@@ -488,11 +489,26 @@ def fleet_half(timeout_s: float) -> dict:
                 t.join(timeout=timeout_s)
             return codes
 
+        def phase_slo() -> SLOTracker:
+            """A fresh tracker per drill phase: its construction-time
+            baseline makes the verdict cover EXACTLY that phase's traffic
+            (obs/slo.py). Availability must hold through the fault —
+            shedding 503s are the admission-control contract and exempt;
+            any unplanned 5xx burns. p95 sized for this 2-core CPU box."""
+            return SLOTracker(fleet.metrics.registry, default_objectives(
+                family_prefix="mine_fleet", p95_s=5.0,
+            ))
+
         # ---- phase A: replica-kill mid-flood --------------------------------
+        slo_a = phase_slo()
         schedule = chaos.install("replica_kill@request=60")
         codes_a = flood(4, 50)
         result["kill_fired"] = schedule.pending() == []
         chaos.uninstall()
+        # the replica kill must stay inside the error budget: the router
+        # absorbed the dropped connections via failover, so availability
+        # holds and the p95 burn rate stays <= 1
+        result["slo_kill"] = slo_a.verdict()
         result["kill_flood_requests"] = len(codes_a)
         result["kill_flood_codes"] = sorted(set(codes_a))
         result["kill_flood_only_200_503"] = all(
@@ -510,6 +526,7 @@ def fleet_half(timeout_s: float) -> dict:
         result["post_kill_all_200"] = all(c == 200 for c in codes_after)
 
         # ---- phase B: hot swap mid-flood ------------------------------------
+        slo_b = phase_slo()
         swap_results: dict = {}
 
         def trigger_swap():
@@ -521,6 +538,10 @@ def fleet_half(timeout_s: float) -> dict:
             swap_results.update(json.loads(body))
 
         codes_b = flood(4, 50, mid_flood=trigger_swap)
+        # the mid-flood swap must not burn budget: zero swap-attributable
+        # 5xx is the phase's existing gate, the SLO verdict restates it in
+        # error-budget terms (availability + p95 burn rate <= 1)
+        result["slo_swap"] = slo_b.verdict()
         result["swap_http_status"] = swap_results.get("status")
         replicas_swapped = swap_results.get("replicas", {})
         in_ring = [r for r in replicas_swapped.values() if r.get("in_ring")]
@@ -581,6 +602,8 @@ def fleet_half(timeout_s: float) -> dict:
             and result["kill_flood_only_200_503"]
             and result["ring_converged_to"] == 2
             and result["post_kill_all_200"]
+            and result["slo_kill"]["ok"]
+            and result["slo_swap"]["ok"]
             and result["swap_http_status"] == 200
             and result["swap_replicas_ok"]
             and result["swap_zero_5xx"]
@@ -735,6 +758,20 @@ def multihost_half(workdir: str, timeout_s: float) -> dict:
         b.get("data_bytes") == per_host_step_bytes * b.get("step", 0)
         for b in beats.values()
     )
+    # straggler attribution off the same heartbeats: the killed host's
+    # beat froze at its last step while the survivors advanced, so the
+    # table names it as the suspect — the attribution an operator would
+    # see BEFORE the watchdog escalates to the named abort. Read now,
+    # before the elastic phase clears the heartbeat dir.
+    stragglers = kill.straggler_table()
+    result["straggler_table"] = stragglers["rows"]
+    result["straggler_suspect"] = stragglers["suspect"]
+    result["straggler_skew_fraction"] = stragglers["skew_fraction"]
+    # every survivor's beat carries its last log-interval sync wait
+    result["sync_wait_in_beats"] = all(
+        r.get("sync_wait_ms") is not None
+        for r in stragglers["rows"]
+    )
 
     ok_kill = (
         result["victim_sigkilled"]
@@ -745,6 +782,9 @@ def multihost_half(workdir: str, timeout_s: float) -> dict:
         and all(v >= 1 for v in result["survivor_flight_dumps"].values())
         and result["last_good_after_kill"] == 2
         and result["host_bytes_quarter"]
+        and result["straggler_suspect"] == 1  # the killed host, by name
+        and result["straggler_skew_fraction"] > 0
+        and result["sync_wait_in_beats"]
     )
     result["kill_ok"] = ok_kill
 

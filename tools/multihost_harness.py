@@ -118,6 +118,12 @@ class LaunchResult:
     def abort_markers(self) -> dict[int, dict]:
         return mh.abort_markers(os.path.join(self.workspace, "heartbeats"))
 
+    def straggler_table(self) -> dict:
+        """Post-mortem straggler attribution off the heartbeat files
+        (resilience/multihost.py straggler_table — reference time is the
+        newest beat, so it reads the same live and after the fact)."""
+        return mh.straggler_table(os.path.join(self.workspace, "heartbeats"))
+
     def flight_dump_dirs(self) -> dict[int, list[str]]:
         """{process_id: flight dump dirs} — proves dumps landed in the
         per-process subdirectories (obs/flight.py `p<idx>-<pid>/`)."""
@@ -274,6 +280,7 @@ def main(argv: list[str] | None = None) -> int:
             str(i): m.get("reason")
             for i, m in result.abort_markers().items()
         },
+        "stragglers": result.straggler_table(),
         "flight_dumps": {
             str(i): len(d) for i, d in result.flight_dump_dirs().items()
         },

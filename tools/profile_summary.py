@@ -89,7 +89,12 @@ def host_pids(meta_events: list[dict]) -> dict[int, str]:
 
 
 def _op_table(events: list[dict], pids: dict[int, str],
-              ops_only: bool = False):
+              ops_only: bool = False,
+              lane_qualified: bool = False):
+    """`lane_qualified` prefixes each op group with its lane's member
+    name — how a MERGED fleet/multi-host timeline (obs/collect.py: one
+    pid lane per process, named "<member> · <orig lane>") keeps r0's
+    `predict` distinct from r1's in one table."""
     total_us = 0.0
     by_op: dict[str, list[float]] = defaultdict(list)
     for ev in events:
@@ -99,7 +104,11 @@ def _op_table(events: list[dict], pids: dict[int, str],
             continue  # scheduling/runtime events sharing the XLA:CPU lane
         dur = float(ev.get("dur", 0.0))
         total_us += dur
-        by_op[ev.get("name", "?")].append(dur)
+        name = ev.get("name", "?")
+        if lane_qualified:
+            member = pids[ev["pid"]].split(" · ")[0]
+            name = f"{member}: {name}"
+        by_op[name].append(dur)
     rows = sorted(
         ((name, sum(durs), len(durs)) for name, durs in by_op.items()),
         key=lambda r: -r[1],
@@ -203,12 +212,24 @@ def summarize(trace_dir: str, top: int = 15) -> dict:
                 "trace (host spans and metadata only)"
             )
     if host_file is not None:
-        total_us, rows = _op_table(cache[host_file], hst_pids)
+        # > 1 host lane = a MERGED timeline (obs/collect.py: the fleet
+        # CLI's `trace` subcommand or training_timeline gave every
+        # process its own named lane) — qualify rows by member so the
+        # table shows WHO spent the time, not one anonymous pool
+        multi_lane = len(set(hst_pids.values())) > 1
+        total_us, rows = _op_table(cache[host_file], hst_pids,
+                                   lane_qualified=multi_lane)
         out.update({
             "host_trace": host_file,
             "host_lanes": sorted(set(hst_pids.values())),
             "host_total_ms": round(total_us / 1e3, 2),
         })
+        if multi_lane:
+            out["note"] = (
+                "merged multi-process host timeline: rows are "
+                "member-qualified; device lanes live in each member's "
+                "own profile capture"
+            )
         out["rows"] += [{
             "op": name[:120], "lane": "host",
             "total_ms": round(tot / 1e3, 2),
@@ -225,6 +246,11 @@ def _lane_error(table: dict, trace_dir: str) -> str | None:
     neither — the bare-trace fallback) are present."""
     has_dev = table.get("trace") is not None
     has_host = table.get("host_trace") is not None
+    if has_host and not has_dev and len(table.get("host_lanes", ())) > 1:
+        # a merged fleet/multi-host timeline is host-side BY CONSTRUCTION
+        # (each member's device trace lives in its own capture dir) — a
+        # missing device lane is the expected shape, not a half-profile
+        return None
     if has_host and not has_dev:
         return (
             f"profile dir {trace_dir} has host spans "
