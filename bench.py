@@ -272,6 +272,48 @@ def _bench_mesh_dims() -> tuple[int, int, int] | None:
     return dims
 
 
+DEFAULT_SHAPE = (384, 512, 32)  # the reference LLFF recipe (BASELINE.md)
+
+
+def _bench_shape() -> tuple[int, int, int] | None:
+    """$BENCH_SHAPE opts the training bench onto another (H, W, S)
+    workload shape: either an explicit "HxWxS" triple or a pretrained-zoo
+    family name ("realestate10k", "kitti_raw", "flowers", "llff") resolved
+    through the conformance contract's capability envelope
+    (mine_tpu/data/conformance/contract.py zoo_shape — RealEstate10K
+    256x384 S=64, KITTI 256x768 S=64, ...). Unset = the classic LLFF
+    recipe shape. The shape keys the metric name AND the perf-ledger
+    workload, so each zoo shape grades against its own baseline stream."""
+    raw = os.environ.get("BENCH_SHAPE", "").strip().lower()
+    if not raw:
+        return None
+    if raw[0].isdigit():
+        parts = tuple(int(p) for p in raw.split("x"))
+        if len(parts) != 3:
+            raise ValueError(f"BENCH_SHAPE={raw!r}: need HxWxS")
+        return parts
+    from mine_tpu.data.conformance.contract import CONTRACTS
+
+    contract = CONTRACTS.get(raw)
+    if contract is None or contract.zoo_shape is None:
+        zoo = sorted(f for f, c in CONTRACTS.items() if c.zoo_shape)
+        raise ValueError(
+            f"BENCH_SHAPE={raw!r}: not an HxWxS triple and not a zoo "
+            f"family ({', '.join(zoo)})"
+        )
+    return contract.zoo_shape
+
+
+def _metric_name() -> str:
+    shape = _bench_shape()
+    if shape is None:
+        return "llff_n32_384x512_train_imgs_per_sec_per_chip"
+    h, w, s = shape
+    raw = os.environ.get("BENCH_SHAPE", "").strip().lower()
+    tag = raw if raw and raw[0].isalpha() else "shape"
+    return f"{tag}_n{s}_{h}x{w}_train_imgs_per_sec_per_chip"
+
+
 def _measure_point(
     batch_size: int,
     profile_dir: str | None = None,
@@ -299,12 +341,14 @@ def _measure_point(
     on_mesh = mesh_dims is not None and mesh_dims != (1, 1, 1)
     byte_stats: dict = {}
 
+    shape_h, shape_w, shape_s = _bench_shape() or DEFAULT_SHAPE
+
     def build(remat: bool):
         overrides = {
             "data.name": "llff",
-            "data.img_h": 384, "data.img_w": 512,
+            "data.img_h": shape_h, "data.img_w": shape_w,
             "data.per_gpu_batch_size": batch_size,
-            "mpi.num_bins_coarse": 32,
+            "mpi.num_bins_coarse": shape_s,
             "loss.smoothness_gmin": 0.8,
             "loss.smoothness_grad_ratio": 0.2,
             "model.remat_decoder": remat,
@@ -363,7 +407,7 @@ def _measure_point(
         """per-device batch_size on every batch replica: the global batch
         is batch_size x replicas, sharded per the rule table's batch row."""
         batch_np = make_synthetic_batch(
-            batch_size * replicas, 384, 512, n_points=256, seed=0
+            batch_size * replicas, shape_h, shape_w, n_points=256, seed=0
         )
         batch_np.pop("src_depth")
         if on_mesh:
@@ -527,7 +571,7 @@ def _run(backend_note: str = "", on_cpu: bool = False) -> None:
         primary = _measure_point(BATCH, profile_dir=profile_dir)
 
     result = {
-        "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
+        "metric": _metric_name(),
         "value": primary["value"],
         "unit": "imgs/sec",
         "vs_baseline": None,
@@ -576,9 +620,10 @@ def _run(backend_note: str = "", on_cpu: bool = False) -> None:
             print(f"# B=8 point failed: {e}", file=sys.stderr)
             result["b8_error"] = f"{type(e).__name__}: {e}"[:500]
 
+    shape_h, shape_w, shape_s = _bench_shape() or DEFAULT_SHAPE
     with _TRACER.span("ledger", cat="bench"):
         _ledger_update(result, workload={
-            "h": 384, "w": 512, "planes": 32, "batch": BATCH,
+            "h": shape_h, "w": shape_w, "planes": shape_s, "batch": BATCH,
             "width_multiple": primary["width_multiple"],
             "recipe": "llff_4scale_adam",
         })
@@ -601,8 +646,12 @@ def _emit_failure(exc: BaseException) -> None:
             "obs": _obs_snapshot(),
         }))
         return
+    try:
+        metric = _metric_name()
+    except Exception:  # noqa: BLE001 - a bad BENCH_SHAPE is the error itself
+        metric = "llff_n32_384x512_train_imgs_per_sec_per_chip"
     print(json.dumps({
-        "metric": "llff_n32_384x512_train_imgs_per_sec_per_chip",
+        "metric": metric,
         "value": None,
         "unit": "imgs/sec",
         "vs_baseline": None,

@@ -1,8 +1,18 @@
-"""Data pipelines (reference: input_pipelines/)."""
+"""Data pipelines (reference: input_pipelines/).
+
+The dataset registry (data/registry.py) is the one name -> builder table;
+data/conformance/ is the contract-and-fixture harness that proves every
+registered config runs train -> eval -> serve hermetically on CPU.
+"""
 
 from mine_tpu.data.pipeline import (
     LoaderRetriesExhausted,
     TransientLoaderError,
     prefetch,
+)
+from mine_tpu.data.registry import (
+    UnknownDatasetError,
+    build_dataset,
+    registered_names,
 )
 from mine_tpu.data.synthetic import SyntheticDataset, make_synthetic_batch

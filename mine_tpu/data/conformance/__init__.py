@@ -1,0 +1,32 @@
+"""Dataset conformance subsystem: "a loader works" as a checkable contract.
+
+Three pieces (ROADMAP "scenario diversity at fleet realism"):
+
+  * `contract.py` — the declarative LoaderContract per dataset family
+    (intrinsics/pose conventions, required batch keys, sparse-depth
+    supervision presence, ragged-val-tail behavior, host_slice capability,
+    pretrained-zoo shape) plus the shipped-config -> family table and the
+    ZOO_BUCKETS the serving/bench layers exercise.
+  * `fixtures.py` — one deterministic on-disk synthetic fixture generator
+    per family (COLMAP dir, RealEstate10K txt sequences, KITTI raw layout,
+    DTU cam grids, light-field tiles, Objectron annotations), all rendering
+    the analytic two-plane scene (data/synthetic.py), so every loader runs
+    hermetically on CPU with nothing downloaded.
+  * `runner.py` — `check_contract` (compile-free batch/geometry/host-slice
+    checks) and `check_loader` (drives the config through the REAL
+    train -> eval -> serve product CLIs against its fixture), emitting one
+    JSON conformance verdict per config.
+
+CLI: `python tools/conformance_run.py` (also `tools/chaos_drill.py --half
+datasets`); tier-1 units in tests/test_conformance.py.
+"""
+
+from mine_tpu.data.conformance.contract import (
+    CONFIG_FAMILIES,
+    CONTRACTS,
+    ZOO_BUCKETS,
+    LoaderContract,
+    contract_for_config,
+)
+from mine_tpu.data.conformance.fixtures import write_fixture
+from mine_tpu.data.conformance.runner import check_contract, check_loader

@@ -36,8 +36,14 @@ from mine_tpu.data import colmap
 # near-plane cull threshold as a fraction of an image's median track depth
 # (load_scene): small enough that genuine foreground (a near occluder at
 # 1/10th the median) survives, large enough that lens-grazing COLMAP
-# artifacts (z ~ 1e-5 of scene scale) cannot reach 1/z supervision
-MIN_DEPTH_FRACTION = 0.01
+# artifacts (z ~ 1e-5 of scene scale) cannot reach 1/z supervision.
+# The cull itself moved to the shared frame core (data/frames.py) when the
+# RealEstate10K loader grew the same need; re-exported for compat.
+from mine_tpu.data.frames import (  # noqa: F401 - re-export
+    MIN_DEPTH_FRACTION,
+    PosedFrameDataset,
+    cull_near_points,
+)
 
 
 @dataclass
@@ -159,20 +165,11 @@ def load_scene(
             ) from None
         pts_cam = (world @ r.T + t).astype(np.float32)  # (N, 3)
         n_tracked = len(pts_cam)
-        # Scene-meaningful near-plane cull, not just z > 0: COLMAP tracks
-        # occasionally triangulate a point millimeters in front of the lens,
-        # and a single z=1e-5 survivor contributes log(1/z) ~ 11.5 to
-        # compute_scale_factor's exp(mean(log...)) — one outlier can shift
-        # the whole image's scale calibration and the log-disparity loss
-        # (ADVICE r5). A point closer than a small fraction of the image's
-        # MEDIAN track depth is a reconstruction artifact, not geometry.
-        z = pts_cam[:, 2]
-        positive = z[z > 0]
-        min_depth = (
-            max(MIN_DEPTH_FRACTION * float(np.median(positive)), 1e-6)
-            if len(positive) else 1e-6
-        )
-        pts_cam = pts_cam[z > min_depth]
+        # Scene-meaningful near-plane cull, not just z > 0 (shared with the
+        # RealEstate10K loader, data/frames.py cull_near_points): a single
+        # lens-grazing COLMAP artifact would dominate the exp(mean(log))
+        # scale calibration and the log-disparity loss (ADVICE r5).
+        pts_cam, min_depth = cull_near_points(pts_cam)
         if len(pts_cam) < min_points:
             raise ValueError(
                 f"{path}: {len(pts_cam)} usable points < required "
@@ -184,31 +181,15 @@ def load_scene(
     return out
 
 
-class LLFFDataset:
-    """Loader-protocol dataset: steps_per_epoch + epoch(n) batch iterator.
+class LLFFDataset(PosedFrameDataset):
+    """Loader-protocol dataset over COLMAP scene directories (the shared
+    frame core, data/frames.py, owns the epoch machinery: drop-last vs
+    wrap-pad tails, num_tgt_views flattening, per-example-seeded
+    host_slice rows)."""
 
-    Replaces torch Dataset + DistributedSampler + DataLoader + collate
-    (train.py:76-132): one logical global batch per step, sharded onto the
-    mesh by the loop.
-    """
-
-    def __init__(self, cfg: Config, split: str, global_batch: int):
-        self.cfg = cfg
-        self.split = split
-        self.global_batch = global_batch
+    def __init__(self, cfg: Config, split: str, global_batch: int,
+                 host_slice: tuple[int, int] | None = None):
         is_val = split == "val"
-        self.is_val = is_val
-        self.rng_seed = cfg.training.seed + (991 if is_val else 0)
-        # num_tgt_views targets per source, each filling one batch slot (the
-        # reference's supervision_count, which it caps at 1 in practice —
-        # synthesis_task.py:203-204; here any k dividing the batch works)
-        self.num_tgt_views = cfg.data.num_tgt_views
-        if self.num_tgt_views < 1 or global_batch % self.num_tgt_views:
-            raise ValueError(
-                f"data.num_tgt_views={self.num_tgt_views} must be >= 1 and "
-                f"divide the global batch {global_batch}"
-            )
-
         ratio = cfg.data.img_pre_downsample_ratio
         folder = "images" if ratio is None or ratio <= 1 else f"images_{ratio}"
         if is_val:
@@ -217,12 +198,12 @@ class LLFFDataset:
         crop = (384, 640) if is_nocs else None
 
         root = cfg.data.training_set_path
-        self.images: list[PosedImage] = []
+        images: list[PosedImage] = []
         for scene in sorted(os.listdir(root)):
             scene_dir = os.path.join(root, scene)
             if not os.path.isdir(scene_dir):
                 continue
-            self.images.extend(
+            images.extend(
                 load_scene(
                     scene_dir, folder, (cfg.data.img_h, cfg.data.img_w),
                     1.0 if is_nocs else ratio,
@@ -233,105 +214,8 @@ class LLFFDataset:
                     min_points=cfg.data.visible_point_count,
                 )
             )
-        if not self.images:
+        if not images:
             raise FileNotFoundError(f"no posed images under {root!r} ({folder})")
-        if not is_val and len(self.images) < global_batch // self.num_tgt_views:
-            # with drop_last a too-small train set would yield ZERO batches
-            # per epoch — a silent no-op training run; fail loudly instead
-            raise ValueError(
-                f"train split has {len(self.images)} source image(s) but one "
-                f"global batch needs {global_batch // self.num_tgt_views}; "
-                "every epoch would be empty (reduce the batch or add data)"
-            )
-        # scene -> global indices (nerf_dataset.py scene_to_indices)
-        self.scene_indices: dict[str, list[int]] = {}
-        for i, im in enumerate(self.images):
-            self.scene_indices.setdefault(im.scene, []).append(i)
-        for scene, idxs in self.scene_indices.items():
-            if len(idxs) < self.num_tgt_views + 1:
-                raise ValueError(
-                    f"scene {scene} has {len(idxs)} image(s); need >= "
-                    f"{self.num_tgt_views + 1} for {self.num_tgt_views} target(s)"
-                )
-
-    def __len__(self) -> int:
-        n_src = self.global_batch // self.num_tgt_views
-        if self.is_val:
-            # val covers EVERY image (reference run_eval iterates the full
-            # val DataLoader, drop_last=False — synthesis_task.py:506-515);
-            # the final short batch is wrap-padded to keep shapes static
-            return -(-len(self.images) // n_src)
-        # train drops the short tail (reference DataLoader drop_last=True,
-        # train.py:110); __len__ must agree with what epoch() yields
-        return len(self.images) // n_src
-
-    @property
-    def num_eval_examples(self) -> int:
-        """Genuine (weight-1) examples one val epoch yields: every image
-        serves as source exactly once, num_tgt_views pairs each. The eval
-        loop audits its metered count against this (training/loop.py
-        run_evaluation) so a wrap-pad miscount can't silently skew the one
-        number users compare."""
-        return len(self.images) * self.num_tgt_views
-
-    def _examples(self, src_idx: int, rng: np.random.Generator) -> list[dict[str, np.ndarray]]:
-        """num_tgt_views (src, tgt) pairs for one source view."""
-        src = self.images[src_idx]
-        scene_idxs = [i for i in self.scene_indices[src.scene] if i != src_idx]
-        k = self.num_tgt_views
-        if self.is_val:
-            # deterministic neighbor(s) (nerf_dataset.py:205-208)
-            base = (src_idx + 1) % len(scene_idxs) - 1
-            tgt_idxs = [scene_idxs[(base + j) % len(scene_idxs)] for j in range(k)]
-        else:
-            tgt_idxs = [int(i) for i in rng.choice(scene_idxs, size=k, replace=False)]
-
-        n_pt = self.cfg.data.visible_point_count
-        out = []
-        for tgt_idx in tgt_idxs:
-            tgt = self.images[tgt_idx]
-            src_pts = src.pts_cam[rng.choice(len(src.pts_cam), n_pt, replace=False)]
-            tgt_pts = tgt.pts_cam[rng.choice(len(tgt.pts_cam), n_pt, replace=False)]
-            # G_tgt_src maps src-camera coords to tgt-camera coords
-            # (reference builds G_src_tgt then inverts at set_data,
-            # nerf_dataset.py:219-221 + synthesis_task.py:211)
-            g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
-            out.append({
-                "src_img": src.img,
-                "tgt_img": tgt.img,
-                "k_src": src.k,
-                "k_tgt": tgt.k,
-                "g_tgt_src": g_tgt_src.astype(np.float32),
-                "pt3d_src": src_pts,
-                "pt3d_tgt": tgt_pts,
-            })
-        return out
-
-    def epoch(self, epoch: int):
-        rng = np.random.default_rng((self.rng_seed, epoch))
-        order = rng.permutation(len(self.images))
-        n_src = self.global_batch // self.num_tgt_views
-        for start in range(0, len(self) * n_src, n_src):
-            idxs = order[start : start + n_src]
-            n_genuine = len(idxs)
-            if n_genuine < n_src:
-                if not self.is_val:  # drop_last, like the reference's train
-                    break            # DataLoader (train.py:110, drop_last=True)
-                # Val: wrap-pad the tail from the start of the order so every
-                # image is evaluated under one static batch shape (XLA: no
-                # ragged batches; a short batch would force a recompile and
-                # break even sharding across the data mesh axis). Padded
-                # slots carry eval_weight 0.0 below, so the epoch average
-                # counts every genuine example exactly once — parity with
-                # the reference's full-set mean over its ragged final batch
-                # (synthesis_task.py:506-515, update(..., n=B)).
-                idxs = np.concatenate([idxs, np.resize(order, n_src - len(idxs))])
-            examples = [e for i in idxs for e in self._examples(int(i), rng)]
-            batch = {
-                k: np.stack([e[k] for e in examples]) for k in examples[0]
-            }
-            if self.is_val:
-                # per-example validity: num_tgt_views examples per source
-                src_w = (np.arange(len(idxs)) < n_genuine).astype(np.float32)
-                batch["eval_weight"] = np.repeat(src_w, self.num_tgt_views)
-            yield batch
+        super().__init__(cfg, split, global_batch, images,
+                         host_slice=host_slice)
+        self.images = self.frames  # historical attribute name

@@ -33,6 +33,7 @@ import numpy as np
 from PIL import Image
 
 from mine_tpu.config import Config
+from mine_tpu.data.frames import PosedFrameDataset
 
 ADJUST = np.array(
     [[0.0, 1.0, 0.0, 0.0],
@@ -112,37 +113,37 @@ def load_objectron_scene(
     return frames
 
 
-class ObjectronDataset:
-    """Loader-protocol dataset over Objectron scene directories."""
+class ObjectronDataset(PosedFrameDataset):
+    """Loader-protocol dataset over Objectron scene directories (shared
+    frame core, data/frames.py; target candidates narrowed to the
+    reference's ±FRAME_WINDOW same-scene neighbors). Val epochs now get
+    the frame core's wrap-padded tail + eval_weight masking — previously
+    a short Objectron val tail was silently dropped."""
 
-    def __init__(self, cfg: Config, split: str, global_batch: int):
-        self.cfg = cfg
-        self.split = split
-        self.is_val = split == "val"
-        self.global_batch = global_batch
-        self.rng_seed = cfg.training.seed + (991 if self.is_val else 0)
-        # see LLFFDataset: k (src, tgt) pairs per source, k slots of the batch
-        self.num_tgt_views = cfg.data.num_tgt_views
-        if self.num_tgt_views < 1 or global_batch % self.num_tgt_views:
-            raise ValueError(
-                f"data.num_tgt_views={self.num_tgt_views} must be >= 1 and "
-                f"divide the global batch {global_batch}"
-            )
-
+    def __init__(self, cfg: Config, split: str, global_batch: int,
+                 host_slice: tuple[int, int] | None = None):
         root = cfg.data.training_set_path
-        self.frames: list[ObjectronFrame] = []
+        frames: list[ObjectronFrame] = []
         for scene in sorted(os.listdir(root)):
             scene_dir = os.path.join(root, scene)
             if not os.path.isdir(scene_dir):
                 continue
-            self.frames.extend(
+            frames.extend(
                 load_objectron_scene(scene_dir, split, (cfg.data.img_h, cfg.data.img_w))
             )
-        if not self.frames:
+        if not frames:
             raise FileNotFoundError(f"no objectron frames under {root!r}")
-        self.scene_indices: dict[str, list[int]] = {}
-        for i, fr in enumerate(self.frames):
-            self.scene_indices.setdefault(fr.scene, []).append(i)
+        super().__init__(cfg, split, global_batch, frames,
+                         host_slice=host_slice)
+
+    def candidate_targets(self, src_idx: int) -> list[int]:
+        # ±FRAME_WINDOW same-scene candidates (objectron.py:176-186)
+        return [
+            i for i in self.scene_indices[self.frames[src_idx].scene]
+            if i != src_idx and abs(i - src_idx) <= FRAME_WINDOW
+        ]
+
+    def _validate_candidates(self) -> None:
         # fail at construction, not hours into an epoch: every frame must
         # have enough in-window neighbors for num_tgt_views distinct targets
         # (bisect count — idxs are sorted — keeps this O(F log F) per scene)
@@ -157,49 +158,3 @@ class ObjectronDataset:
                         f"±{FRAME_WINDOW}; need >= num_tgt_views="
                         f"{self.num_tgt_views}"
                     )
-
-    def __len__(self) -> int:
-        return max(len(self.frames) // (self.global_batch // self.num_tgt_views), 1)
-
-    def _examples(self, src_idx: int, rng: np.random.Generator) -> list[dict[str, np.ndarray]]:
-        src = self.frames[src_idx]
-        # ±FRAME_WINDOW same-scene candidates (objectron.py:176-186)
-        neighbors = [
-            i for i in self.scene_indices[src.scene]
-            if i != src_idx and abs(i - src_idx) <= FRAME_WINDOW
-        ]
-        k = self.num_tgt_views  # >= k neighbors guaranteed by __init__
-        if self.is_val:
-            base = (src_idx + 1) % len(neighbors) - 1
-            tgt_idxs = [neighbors[(base + j) % len(neighbors)] for j in range(k)]
-        else:
-            tgt_idxs = [int(i) for i in rng.choice(neighbors, size=k, replace=False)]
-
-        n_pt = self.cfg.data.visible_point_count
-        out = []
-        for tgt_idx in tgt_idxs:
-            tgt = self.frames[tgt_idx]
-            src_sel = rng.choice(len(src.pts_cam), n_pt, replace=len(src.pts_cam) < n_pt)
-            tgt_sel = rng.choice(len(tgt.pts_cam), n_pt, replace=len(tgt.pts_cam) < n_pt)
-            g_tgt_src = tgt.g_cam_world @ np.linalg.inv(src.g_cam_world)
-            out.append({
-                "src_img": src.img,
-                "tgt_img": tgt.img,
-                "k_src": src.k,
-                "k_tgt": tgt.k,
-                "g_tgt_src": g_tgt_src.astype(np.float32),
-                "pt3d_src": src.pts_cam[src_sel],
-                "pt3d_tgt": tgt.pts_cam[tgt_sel],
-            })
-        return out
-
-    def epoch(self, epoch: int):
-        rng = np.random.default_rng((self.rng_seed, epoch))
-        order = rng.permutation(len(self.frames))
-        n_src = self.global_batch // self.num_tgt_views
-        for start in range(0, len(self) * n_src, n_src):
-            idxs = order[start : start + n_src]
-            if len(idxs) < n_src:
-                break
-            examples = [e for i in idxs for e in self._examples(int(i), rng)]
-            yield {k: np.stack([e[k] for e in examples]) for k in examples[0]}

@@ -46,7 +46,7 @@ def main(argv: list[str] | None = None) -> dict[str, float]:
         make_parallel_eval_step,
         model_axes,
     )
-    from mine_tpu.train import build_dataset
+    from mine_tpu.data.registry import build_dataset
     from mine_tpu.training import build_model, init_state, make_optimizer
     from mine_tpu.training import checkpoint as ckpt
     from mine_tpu.training.loop import run_evaluation

@@ -727,6 +727,18 @@ class RenderEngine:
 
     # -- pre-warming ---------------------------------------------------------
 
+    def _build_predict(self, bucket: _Bucket) -> None:
+        """Warmup hook: materialize one bucket's predict executable
+        (FakeEngine overrides with marker registration so the warm-pool
+        accounting is provable without XLA)."""
+        bucket.predict_executable()
+
+    def _build_render(self, bucket: _Bucket, n_poses: int,
+                      n_planes: int) -> None:
+        """Warmup hook: materialize one (n_planes, n_poses) render
+        executable."""
+        bucket.render_executable(n_poses, n_planes)
+
     def warmup(
         self,
         specs: list[BucketSpec] | None = None,
@@ -740,15 +752,40 @@ class RenderEngine:
         bucket, so those executables are part of the expected set too —
         otherwise the first live render of each (planes, poses) pair would
         pay a blocking compile on the request path, the cold start warmup
-        exists to avoid. log2(S) x pose buckets, bounded."""
+        exists to avoid. log2(S) x pose buckets, bounded.
+
+        This IS the per-bucket warm-pool contract the mixed-bucket fleet
+        bench gates (tools/bench_fleet.py --mixed-bucket): after
+        warmup(declared_buckets), the `compiles` counter must stay FLAT
+        through any flood that requests only declared buckets — and
+        through hot swaps, whose verify step re-proves each warm bucket's
+        executables against the new weights instead of rebuilding them
+        (swap_weights step 3)."""
         before = self.compiles
         for spec in (specs if specs is not None else [self.default_bucket]):
             bucket = self.bucket(spec)
-            bucket.predict_executable()
+            self._build_predict(bucket)
             plane_counts = (bucket.plane_buckets if self.prune_eps
                             else (bucket.num_planes,))
             for nb in (pose_counts if pose_counts is not None
                        else self.pose_buckets):
                 for n_planes in plane_counts:
-                    bucket.render_executable(self._pose_bucket(nb), n_planes)
+                    self._build_render(bucket, self._pose_bucket(nb),
+                                       n_planes)
         return self.compiles - before
+
+    def warm_pool(self) -> dict[str, dict]:
+        """Per-bucket executable inventory — which buckets hold a resident
+        predict executable and which (n_planes, n_poses) render
+        executables exist. Surfaced via /healthz so an operator (and the
+        mixed-bucket bench) can see whether a replica's declared buckets
+        are actually warm before traffic lands on them."""
+        out: dict[str, dict] = {}
+        for spec in self.bucket_specs():
+            with self._buckets_lock:
+                bucket = self._buckets[spec]
+            out["x".join(str(v) for v in spec)] = {
+                "predict": bucket._predict_exec is not None,
+                "render": sorted(list(bucket._render_execs)),
+            }
+        return out

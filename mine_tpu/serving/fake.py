@@ -41,6 +41,7 @@ int8.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 from typing import Any, Callable
 
@@ -97,6 +98,16 @@ class FakeEngine(RenderEngine):
                          checkpoint_step=checkpoint_step, **kwargs)
         self.render_delay_s = render_delay_s
         self.predict_delay_s = predict_delay_s
+        # fake-executable accounting: the first touch of each (bucket)
+        # predict / (bucket, n_planes, n_poses) render "compiles" a marker
+        # into the SAME per-bucket slots the real engine fills, ticking the
+        # SAME engine.compiles counter — so warm-pool coverage claims
+        # ("no compile stall mid-flood for a pre-declared bucket",
+        # tools/bench_fleet.py --mixed-bucket) are provable through the
+        # control plane without XLA: a request landing on an executable
+        # warmup() never built moves the counter, exactly like a real
+        # replica would pay a blocking compile there.
+        self._fake_lock = threading.Lock()
 
     def _place_variables(self, params: Any, batch_stats: Any) -> Any:
         # host numpy stays host numpy: no jax backend touch, no stderr
@@ -110,7 +121,22 @@ class FakeEngine(RenderEngine):
         # backend dependency the fake exists to avoid
         return entry
 
+    # -- fake executable registry (the real engine's compile accounting) ----
+
+    def _build_predict(self, bucket) -> None:
+        with self._fake_lock:
+            if bucket._predict_exec is None:
+                bucket._predict_exec = "fake-exec"
+                self._count_compile("predict")
+
+    def _build_render(self, bucket, n_poses: int, n_planes: int) -> None:
+        with self._fake_lock:
+            if (n_planes, n_poses) not in bucket._render_execs:
+                bucket._render_execs[(n_planes, n_poses)] = "fake-exec"
+                self._count_compile("render")
+
     def _dispatch_predict(self, bucket, img, variables):
+        self._build_predict(bucket)  # first touch = the would-be compile
         if self.predict_delay_s:
             time.sleep(self.predict_delay_s)
         h, w, _ = bucket.spec
@@ -182,6 +208,18 @@ class FakeEngine(RenderEngine):
             time.sleep(self.render_delay_s)
         n = poses.shape[0]
         h, w, _ = entry.bucket
+        # the real engine's executable-selection arithmetic, against the
+        # fake registry: which (n_planes, n_poses) executables would this
+        # dispatch run? First touch ticks the compile counter.
+        bucket = self.bucket(entry.bucket)
+        if isinstance(entry, CompressedMPI):
+            n_planes = bucket.plane_bucket(entry.planes_kept)
+        else:
+            n_planes = bucket.num_planes
+        max_b = self.pose_buckets[-1]
+        for start in range(0, n, max_b):  # n == 0 touches nothing, like
+            chunk = min(n - start, max_b)  # the real early return
+            self._build_render(bucket, self._pose_bucket(chunk), n_planes)
         if isinstance(entry, CompressedMPI):
             rgb_slab = np.asarray(decompress(entry)[0])  # numpy dequant
         else:

@@ -803,6 +803,9 @@ class ServingApp:
             "swap_state": self.swap_status().get("state", "idle"),
             "buckets": [list(s) for s in self.engine.bucket_specs()],
             "compiles": self.engine.compiles,
+            # per-bucket executable inventory (engine.warm_pool): is every
+            # DECLARED bucket actually warm before traffic lands on it?
+            "warm_pool": self.engine.warm_pool(),
             "cache_entries": len(self.cache),
             "cache_bytes_resident": self.cache.bytes_resident,
             "queue_depth": self.batcher.queue_depth(),
@@ -1200,6 +1203,14 @@ def main(argv: list[str] | None = None) -> None:
         "is always served). Each bucket costs one-time XLA compiles and "
         "O(S*H*W) cache bytes per entry — hence operator-allowlisted.",
     )
+    parser.add_argument(
+        "--zoo-buckets", action="store_true",
+        help="allowlist the pretrained-zoo capability-envelope shapes "
+        "(RealEstate10K 256x384x64, KITTI 256x768x64, Flowers 384x512x64, "
+        "LLFF 384x512x32 — data/conformance/contract.py ZOO_BUCKETS) in "
+        "one flag; warmup pre-compiles them all, so mixed zoo traffic "
+        "never eats a compile stall mid-flood",
+    )
     parser.add_argument("--fov", type=float, default=90.0)
     parser.add_argument(
         "--extra_config", default=None,
@@ -1269,6 +1280,10 @@ def main(argv: list[str] | None = None) -> None:
     extra_buckets = [
         tuple(int(v) for v in spec.split(",")) for spec in args.bucket
     ]
+    if args.zoo_buckets:
+        from mine_tpu.data.conformance.contract import ZOO_BUCKETS
+
+        extra_buckets.extend(ZOO_BUCKETS)
     app = ServingApp(
         cfg, params, batch_stats, checkpoint_step=step,
         cache_bytes=args.cache_mb << 20, max_delay_ms=args.max_delay_ms,

@@ -14,41 +14,15 @@ import os
 
 def build_dataset(cfg, split: str, global_batch: int,
                   host_slice: tuple[int, int] | None = None):
-    """Dataset factory (reference train.py:72-164 get_dataset).
+    """Dataset factory — the registry's table, re-exported here for the
+    historical import path (data/registry.py is the implementation; every
+    registered loader honors `host_slice=(start, count)`, materializing
+    only this host's rows of each global batch). Unknown names raise
+    UnknownDatasetError listing what IS registered and pointing at the
+    conformance runner (tools/conformance_run.py)."""
+    from mine_tpu.data.registry import build_dataset as registry_build
 
-    `host_slice` is (start, count) of the global batch THIS host should
-    materialize (Trainer.host_batch_slice, off the `^batch/` partition
-    row). Loaders that honor it build only their rows — each host's IO
-    drops to 1/N of the global batch (the DistributedSampler role).
-    Loaders without support ignore it and return global batches; staging
-    slices those down on multi-process runs (numerically identical,
-    parallel/mesh.py shard_batch — just wasteful host IO)."""
-    name = cfg.data.name
-    if name == "synthetic":
-        # data.num_tgt_views is a no-op here by design: every synthetic batch
-        # slot is a fresh procedural scene, so "k targets per source" has no
-        # shared-source meaning (the real loaders implement it)
-        from mine_tpu.data import SyntheticDataset
-
-        return SyntheticDataset(
-            cfg.data.img_h, cfg.data.img_w, global_batch,
-            steps_per_epoch=12 if split == "train" else 2,
-            n_points=cfg.data.visible_point_count,
-            seed=cfg.training.seed + (0 if split == "train" else 10_000),
-            host_slice=host_slice,
-        )
-    if name in ("llff", "nocs_llff"):
-        from mine_tpu.data.llff import LLFFDataset
-
-        return LLFFDataset(cfg, split, global_batch)
-    if name == "objectron":
-        from mine_tpu.data.objectron import ObjectronDataset
-
-        return ObjectronDataset(cfg, split, global_batch)
-    raise NotImplementedError(
-        f"dataset {name!r} has no pipeline yet (reference parity: train.py:161-162 "
-        "raises NotImplementedError for realestate10k/flowers/kitti_raw/dtu too)"
-    )
+    return registry_build(cfg, split, global_batch, host_slice=host_slice)
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -1,0 +1,345 @@
+"""Dataset conformance subsystem (mine_tpu/data/conformance/): contract
+rung for every shipped config, per-family fixture/parser units, registry
+and README-matrix drift guards, host-slice bitwise pins, and the
+mixed-bucket fleet warm-pool proof. Everything here is compile-free and
+budgeted in single-digit seconds; the full nine-config train->eval->serve
+sweep is the slow-marked test at the bottom (also:
+`python tools/conformance_run.py` / `tools/chaos_drill.py --half
+datasets`)."""
+
+import json
+import os
+import pathlib
+import re
+
+import numpy as np
+import pytest
+
+from mine_tpu.config import Config
+from mine_tpu.data.conformance.contract import (
+    CONFIG_FAMILIES,
+    CONTRACTS,
+    ZOO_BUCKETS,
+    all_config_names,
+    contract_for_config,
+)
+from mine_tpu.data.conformance.fixtures import write_fixture
+from mine_tpu.data.conformance.runner import check_contract
+from mine_tpu.data.registry import (
+    UnknownDatasetError,
+    build_dataset,
+    registered_names,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# -- the compile-free contract rung, every shipped config --------------------
+
+
+@pytest.mark.parametrize("config_name", all_config_names())
+def test_contract_rung(config_name, tmp_path):
+    """Every shipped config passes the full compile-free contract check
+    against its hermetic fixture: batch keys/shapes, pixels-at-target K,
+    rigid poses, sparse-depth presence matching NO_DISP_SUPERVISION,
+    wrap-padded val tails with exact eval_weight bookkeeping, and the
+    host-slice bitwise equality."""
+    verdict = check_contract(config_name, str(tmp_path / config_name))
+    failed = {k: v for k, v in verdict["checks"].items() if v != "ok"}
+    assert verdict["ok"], failed
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_unknown_name_lists_registered():
+    cfg = Config().replace(**{"data.name": "imagenet"})
+    with pytest.raises(UnknownDatasetError) as exc:
+        build_dataset(cfg, "train", 2)
+    msg = str(exc.value)
+    for name in registered_names():
+        assert name in msg
+    assert "conformance_run" in msg
+
+
+def test_every_contract_family_is_registered():
+    assert set(CONTRACTS) == set(registered_names())
+
+
+def test_every_shipped_config_is_in_the_matrix():
+    """The 'nine configs' set is pinned: every non-default yaml has a
+    contract-family row, every row has a yaml, and each yaml's data.name
+    is the registered family the row claims."""
+    import yaml
+
+    assert set(all_config_names()) == set(CONFIG_FAMILIES)
+    for config_name, family in CONFIG_FAMILIES.items():
+        with open(REPO / "mine_tpu" / "configs"
+                  / (config_name + ".yaml")) as fh:
+            raw = yaml.safe_load(fh)
+        assert raw.get("data.name", "llff") == family, config_name
+        assert family in registered_names()
+
+
+def test_sparse_depth_flags_agree_with_training_step():
+    """The contract's sparse_depth and training/step.py's
+    NO_DISP_SUPERVISION are two spellings of one fact — they must never
+    drift (a loader shipping pt3d the step ignores, or vice versa)."""
+    from mine_tpu.training.step import NO_DISP_SUPERVISION
+
+    for family, contract in CONTRACTS.items():
+        assert contract.sparse_depth == (
+            family not in NO_DISP_SUPERVISION
+        ), family
+
+
+# -- host_slice: the bitwise per-host data-sharding pin ----------------------
+
+
+def _fixture_cfg(family, path, **extra):
+    return Config().replace(**{
+        "data.name": family,
+        "data.img_h": 48, "data.img_w": 64,
+        "data.img_pre_downsample_ratio": 1.0,
+        "data.training_set_path": path,
+        "data.visible_point_count": 16,
+        **extra,
+    })
+
+
+@pytest.mark.parametrize("family", ["llff", "objectron", "realestate10k"])
+def test_host_slice_bitwise_two_host_split(family, tmp_path):
+    """ROADMAP multi-host rung (b): a host materializing only its
+    (start, count) rows produces BITWISE the rows of a global build — for
+    the retrofitted LLFF/Objectron loaders (previously the
+    global-load-then-slice compat path) and a new-family representative.
+    Both halves of a 2-host split, every key, every step of the epoch."""
+    if family == "objectron":
+        path = write_fixture(family, str(tmp_path), n_frames=8)
+        cfg = _fixture_cfg(family, path, **{"data.img_h": 48,
+                                            "data.img_w": 48})
+    else:
+        path = write_fixture(family, str(tmp_path), n_views=8) \
+            if family == "llff" else write_fixture(family, str(tmp_path),
+                                                   n_frames=8)
+        cfg = _fixture_cfg(family, path)
+    global_batch = 4
+    full = list(build_dataset(cfg, "train", global_batch).epoch(0))
+    h0 = list(build_dataset(cfg, "train", global_batch,
+                            host_slice=(0, 2)).epoch(0))
+    h1 = list(build_dataset(cfg, "train", global_batch,
+                            host_slice=(2, 2)).epoch(0))
+    assert len(full) == len(h0) == len(h1) >= 1
+    for fb, a, b in zip(full, h0, h1):
+        for key in fb:
+            assert np.array_equal(fb[key][0:2], a[key]), key
+            assert np.array_equal(fb[key][2:4], b[key]), key
+
+
+def test_host_slice_splits_a_tgt_view_group(tmp_path):
+    """A host slice cutting THROUGH one source's num_tgt_views slot group
+    still reproduces the global rows bitwise (the per-SOURCE generator
+    draws the k targets together; the slice trims rows after)."""
+    path = write_fixture("llff", str(tmp_path), n_views=8)
+    cfg = _fixture_cfg("llff", path, **{"data.num_tgt_views": 2})
+    full = next(iter(build_dataset(cfg, "train", 4).epoch(0)))
+    mid = next(iter(build_dataset(cfg, "train", 4,
+                                  host_slice=(1, 2)).epoch(0)))
+    for key in full:
+        assert np.array_equal(full[key][1:3], mid[key]), key
+
+
+# -- family-specific parser failure modes ------------------------------------
+
+
+def test_realestate_malformed_camera_line_names_the_line(tmp_path):
+    from mine_tpu.data.realestate import parse_camera_file
+
+    p = tmp_path / "seq.txt"
+    p.write_text("https://example.test/x\n123 1.0 1.0 0.5\n")
+    with pytest.raises(ValueError, match=r"seq\.txt:2.*19 fields"):
+        parse_camera_file(str(p))
+
+
+def test_realestate_missing_point_cloud_is_loud(tmp_path):
+    path = write_fixture("realestate10k", str(tmp_path))
+    os.remove(os.path.join(path, "points", "seq_train.npz"))
+    cfg = _fixture_cfg("realestate10k", path)
+    with pytest.raises(FileNotFoundError, match="no SfM point cloud"):
+        build_dataset(cfg, "train", 2)
+
+
+def test_kitti_missing_p2_and_truncated_poses(tmp_path):
+    from mine_tpu.data.kitti import parse_calib
+
+    p = tmp_path / "calib.txt"
+    p.write_text("P0: " + " ".join(["0"] * 12) + "\n")
+    with pytest.raises(ValueError, match="no P2 row"):
+        parse_calib(str(p))
+
+    path = write_fixture("kitti_raw", str(tmp_path / "fix"))
+    drive = os.path.join(path, "2011_09_26_drive_0001_sync")
+    poses = open(os.path.join(drive, "poses.txt")).read().splitlines()
+    with open(os.path.join(drive, "poses.txt"), "w") as fh:
+        fh.write("\n".join(poses[:2]) + "\n")  # 4 frames, 2 pose rows
+    cfg = _fixture_cfg("kitti_raw", path)
+    with pytest.raises(ValueError, match="beyond the 2 rows"):
+        build_dataset(cfg, "train", 2)
+
+
+def test_dtu_cam_file_without_sections_is_loud(tmp_path):
+    from mine_tpu.data.dtu import parse_cam_file
+
+    p = tmp_path / "bad_cam.txt"
+    p.write_text("1 0 0 0\n0 1 0 0\n")
+    with pytest.raises(ValueError, match="extrinsic.*intrinsic"):
+        parse_cam_file(str(p))
+
+
+def test_flowers_bad_grid_tiling_is_loud(tmp_path):
+    from PIL import Image
+
+    path = write_fixture("flowers", str(tmp_path))
+    bad = os.path.join(path, "grids", "sample_0.png")
+    Image.open(bad).resize((191, 191)).save(bad)  # not divisible by 3
+    cfg = _fixture_cfg("flowers", path, **{"data.img_w": 48})
+    with pytest.raises(ValueError, match="not a 3x3 tiling"):
+        build_dataset(cfg, "train", 2)
+
+
+# -- zoo shapes + mixed-bucket fleet warm pool -------------------------------
+
+
+def test_zoo_buckets_are_engine_legal():
+    """Every capability-envelope shape satisfies the model's 128-multiple
+    constraint and carries the BASELINE.md headline shapes."""
+    assert (256, 384, 64) in ZOO_BUCKETS  # RealEstate10K
+    assert (256, 768, 64) in ZOO_BUCKETS  # KITTI
+    for h, w, s in ZOO_BUCKETS:
+        assert h % 128 == 0 and w % 128 == 0 and s >= 2
+
+
+def test_fake_engine_compile_counter_is_not_vacuous():
+    """The mixed-bucket gate's instrument: a request landing on an
+    executable warmup() never built MOVES engine.compiles (FakeEngine's
+    registry mirrors the real engine's compile accounting), and a warmed
+    bucket does not."""
+    from mine_tpu.serving.fake import FakeEngine
+
+    cfg = Config().replace(**{
+        "data.img_h": 128, "data.img_w": 128, "mpi.num_bins_coarse": 4,
+    })
+    engine = FakeEngine(cfg=cfg)
+    declared = [(128, 128, 4), (128, 256, 4)]
+    built = engine.warmup(specs=declared)
+    assert built == engine.compiles > 0
+    # warm traffic: no movement
+    before = engine.compiles
+    img = np.zeros((16, 16, 3), np.uint8)
+    entry = engine.predict(img, spec=(128, 128, 4))
+    engine.render(entry, np.eye(4)[None])
+    assert engine.compiles == before
+    # an UNDECLARED bucket: the would-be compile stall is counted
+    engine.predict(img, spec=(256, 128, 4))
+    assert engine.compiles == before + 1
+    # warm_pool names what is resident
+    pool = engine.warm_pool()
+    assert pool["128x128x4"]["predict"] and pool["128x256x4"]["predict"]
+    assert len(pool["128x128x4"]["render"]) == len(engine.pose_buckets)
+
+
+def test_mixed_bucket_fleet_smoke():
+    """The tier-1 slice of `bench_fleet.py --mixed-bucket`: 2 fake
+    replicas, 3 declared (H, W, S) buckets interleaved in one skew trace,
+    a mid-flood hot swap — zero mid-flood compiles (warm pools cover the
+    declared set AND survive the swap), zero 5xx, every replica on the
+    new generation."""
+    import sys
+
+    sys.path.insert(0, str(REPO / "tools"))
+    from bench_fleet import run_mixed_bucket
+
+    result = run_mixed_bucket(replicas=2, images=6, requests=24,
+                              concurrency=3)
+    assert result["mid_flood_compiles"] == 0
+    assert result["swap_mid_flood"]
+    for rep in result["per_replica"]:
+        assert rep["mid_flood_compiles"] == 0
+        assert rep["weight_generation"] == 1
+        assert rep["warm_predicts"]
+        assert len(rep["warm_buckets"]) == 3
+
+
+# -- README dataset matrix drift guard ---------------------------------------
+
+_MATRIX_BEGIN = "<!-- dataset-matrix:begin -->"
+_MATRIX_END = "<!-- dataset-matrix:end -->"
+
+
+def _matrix_rows() -> dict[str, dict]:
+    text = (REPO / "README.md").read_text()
+    begin = text.index(_MATRIX_BEGIN)
+    end = text.index(_MATRIX_END)
+    rows = {}
+    for line in text[begin:end].splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) < 6 or cells[0] in ("config", "---") \
+                or set(cells[0]) <= {"-"}:
+            continue
+        rows[cells[0].strip("`")] = {
+            "family": cells[1].strip("`"),
+            "loader": cells[2].strip("`"),
+            "sparse_depth": cells[3],
+            "host_slice": cells[4],
+            "zoo_shape": cells[5],
+            "conformance": cells[6] if len(cells) > 6 else "",
+        }
+    return rows
+
+
+def test_readme_dataset_matrix_matches_the_contracts():
+    """The README's machine-readable Dataset matrix and the contract
+    table must agree in BOTH directions (the test_metrics_docs.py idiom):
+    every shipped config has a row, no row is stale, and each row's
+    family/loader/sparse/host_slice/zoo cells restate the contract."""
+    rows = _matrix_rows()
+    assert set(rows) == set(CONFIG_FAMILIES), (
+        "README dataset-matrix rows must cover exactly the shipped "
+        f"configs; missing {set(CONFIG_FAMILIES) - set(rows)}, stale "
+        f"{set(rows) - set(CONFIG_FAMILIES)}"
+    )
+    for config_name, row in rows.items():
+        contract = contract_for_config(config_name)
+        assert row["family"] == contract.family, config_name
+        assert row["loader"] == contract.loader, config_name
+        assert row["sparse_depth"] == ("yes" if contract.sparse_depth
+                                       else "no"), config_name
+        assert row["host_slice"] == ("yes" if contract.host_slice
+                                     else "no"), config_name
+        want_zoo = ("x".join(str(v) for v in contract.zoo_shape)
+                    if contract.zoo_shape else "—")
+        assert row["zoo_shape"] == want_zoo, config_name
+        assert row["conformance"] == "checked", config_name
+
+
+# -- the full product-CLI sweep (slow) ---------------------------------------
+
+
+@pytest.mark.slow
+def test_full_matrix_train_eval_serve(tmp_path):
+    """The acceptance sweep: all nine configs through the REAL product
+    CLIs (train -> eval -> serve) against their hermetic fixtures — four
+    of the families for the first time ever. ~1-2 min per config on a
+    2-core CPU box (three XLA compiles each); also runnable as
+    `python tools/conformance_run.py` or `chaos_drill.py --half
+    datasets`."""
+    from mine_tpu.data.conformance.runner import run_matrix
+
+    summary = run_matrix(str(tmp_path))
+    failures = [
+        {r["config"]: {s: res for s, res in r["stages"].items()
+                       if not res.get("ok")}}
+        for r in summary["results"] if not r["ok"]
+    ]
+    assert summary["ok"], json.dumps(failures, indent=2)[:4000]
+    assert summary["configs_checked"] == len(all_config_names()) == 9

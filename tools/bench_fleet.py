@@ -42,6 +42,10 @@ importable for the tier-1 smoke.
   python tools/bench_fleet.py                          # 3 fake replicas
   python tools/bench_fleet.py --replicas 5 --requests 400
   python tools/bench_fleet.py --tier int8 --prune-eps 1e-3
+  python tools/bench_fleet.py --mixed-bucket [--zoo]   # heterogeneous
+    # traffic: several (H, W, S) shapes in ONE skew trace, per-bucket AOT
+    # warm pools, mid-flood hot swap, compile counter asserted FLAT
+    # (run_mixed_bucket; dedicated fleet_mixed_bucket ledger stream)
 """
 
 from __future__ import annotations
@@ -63,7 +67,15 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 METRIC = "fleet_renders_per_sec"
 ECON_METRIC = "fleet_cache_economics"
+MIXED_METRIC = "fleet_mixed_bucket"
 BENCH_PLANES = 8  # enough planes that pruning has something to prune
+
+# the default mixed-bucket shape set: three genuinely different (H, W, S)
+# executables at bench-friendly sizes (every H/W a 128-multiple, like the
+# engine demands). --zoo swaps in the pretrained-zoo capability envelope
+# (RealEstate10K/KITTI/Flowers/LLFF shapes, data/conformance/contract.py)
+# — same control plane, production-sized slabs.
+MIXED_BUCKETS = ((128, 128, 8), (128, 256, 8), (256, 128, 16))
 
 
 def _make_pngs(n: int, size: int = 8) -> list[bytes]:
@@ -452,6 +464,236 @@ def run_tier_compare(
     }
 
 
+def run_mixed_bucket(
+    replicas: int = 2,
+    images: int = 12,
+    requests: int = 120,
+    concurrency: int = 4,
+    buckets: tuple[tuple[int, int, int], ...] | None = None,
+    swap_mid_flood: bool = True,
+    vnodes: int = 64,
+) -> dict:
+    """Heterogeneity as the serving workload: several (H, W, S) shapes
+    interleaved in ONE skew trace, with per-bucket AOT warm pools and a
+    mid-flood hot swap — the ROADMAP "mixed traffic" scenario, provable
+    compile-free (FakeEngine's executable registry ticks the SAME
+    engine.compiles counter a real replica's XLA compiles would).
+
+    Gates (raise on violation — the bench fails loudly, bench.py
+    discipline):
+      * zero mid-flood compiles: every replica warms its DECLARED bucket
+        set before traffic; the compile counter must be FLAT across the
+        flood — a replica that has never compiled KITTI's shape must not
+        eat a compile stall when KITTI traffic lands on it.
+      * the warm pool SURVIVES the hot swap: /admin/swap fans out
+        mid-flood (every replica flips generation); swap verify re-proves
+        the warm buckets' executables instead of rebuilding them
+        (serving/engine.py swap_weights step 3), so the counter stays
+        flat through the swap too, and post-swap traffic still runs warm.
+      * zero 5xx across the whole trace.
+
+    Returns the result dict; the CLI appends it to the dedicated
+    `fleet_mixed_bucket` perf-ledger stream (p95 gated by
+    `perf_ledger.py check`).
+    """
+    import numpy as np
+
+    from mine_tpu.config import Config
+    from mine_tpu.serving.fake import fake_checkpoint, make_fake_app
+    from mine_tpu.serving.fleet import FleetApp, make_fleet_server
+    from mine_tpu.serving.server import make_server
+
+    buckets = tuple(buckets) if buckets else MIXED_BUCKETS
+    h0, w0, s0 = buckets[0]
+    cfg = Config().replace(**{
+        "data.img_h": h0, "data.img_w": w0, "mpi.num_bins_coarse": s0,
+    })
+    apps, servers, urls = [], [], {}
+    try:
+        for i in range(replicas):
+            app = make_fake_app(
+                cfg=cfg, checkpoint_step=0,
+                swap_source=lambda: fake_checkpoint(1),
+                allowed_buckets=list(buckets),
+            )
+            # per-bucket AOT warm pool: every DECLARED bucket's predict +
+            # render executables built before traffic (the real server CLI
+            # does exactly this over its --bucket allowlist)
+            warm_compiles = app.engine.warmup(specs=list(buckets))
+            assert warm_compiles > 0
+            srv = make_server(app)
+            host, port = srv.server_address[:2]
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+            apps.append(app)
+            servers.append(srv)
+            urls[f"r{i}"] = f"http://{host}:{port}"
+        fleet = FleetApp(urls, probe_interval_s=1.0, vnodes=vnodes).start()
+        fleet_srv = make_fleet_server(fleet)
+        fh, fp = fleet_srv.server_address[:2]
+        threading.Thread(target=fleet_srv.serve_forever, daemon=True).start()
+        base = f"http://{fh}:{fp}"
+
+        # the warm-pool snapshot the flood is gated against
+        compiles_before = {
+            name: json.loads(_http(url, "/healthz")[1])["compiles"]
+            for name, url in urls.items()
+        }
+
+        # seed: every image predicted once at ITS bucket (round-robin over
+        # the declared set — the mixed working set resident fleet-wide)
+        import base64
+
+        pngs = _make_pngs(images)
+        img_buckets = [buckets[i % len(buckets)] for i in range(images)]
+        keys: list[str] = []
+        for png, spec in zip(pngs, img_buckets):
+            payload = json.dumps({
+                "image_b64": base64.b64encode(png).decode(),
+                "bucket": list(spec),
+            }).encode()
+            code, body = _http(base, "/predict", data=payload,
+                               headers={"Content-Type": "application/json"})
+            assert code == 200, body
+            resp = json.loads(body)
+            assert resp["bucket"] == list(spec)
+            keys.append(resp["mpi_key"])
+
+        # skewed popularity over the MIXED set: consecutive popular ranks
+        # alternate buckets by construction, so every client interleaves
+        # shapes — the per-request bucket switch a homogeneous bench never
+        # exercises
+        rng = np.random.default_rng(0)
+        weights = 1.0 / np.arange(1, images + 1)
+        weights /= weights.sum()
+        picks = rng.choice(images, size=requests, p=weights)
+        work = [
+            (json.dumps({
+                "image_b64": base64.b64encode(pngs[i]).decode(),
+                "bucket": list(img_buckets[i]),
+            }).encode(), json.dumps({
+                "mpi_key": keys[i], "offsets": [[0.01, 0.0, 0.0]],
+            }).encode())
+            for i in picks
+        ]
+        work_lock = threading.Lock()
+        latencies: list[float] = []
+        errors: list[str] = []
+        swap_results: dict = {}
+        swap_at = len(work) // 2 if swap_mid_flood else -1
+
+        def client():
+            hdr = {"Content-Type": "application/json"}
+            while True:
+                with work_lock:
+                    if not work:
+                        return
+                    predict_payload, render_payload = work.pop()
+                    fire_swap = len(work) == swap_at
+                if fire_swap:
+                    # mid-flood rolling upgrade through the router's
+                    # fan-out — the warm pools must carry over
+                    swap_results.update(fleet.swap_all(wait=True))
+                t0 = time.perf_counter()
+                c1, b1 = _http(base, "/predict", data=predict_payload,
+                               headers=hdr)
+                c2, _ = _http(base, "/render", data=render_payload,
+                              headers=hdr)
+                dt = time.perf_counter() - t0
+                with work_lock:
+                    if c1 == 200 and c2 == 200:
+                        latencies.append(dt)
+                    else:
+                        errors.append(f"predict={c1} render={c2}")
+
+        clients = [threading.Thread(target=client)
+                   for _ in range(concurrency)]
+        t0 = time.perf_counter()
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=600)
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(
+                f"{len(errors)}/{requests} mixed-bucket requests failed: "
+                f"{errors[0]}"
+            )
+
+        # the compile-counter proof + warm-pool-survives-swap audit
+        per_replica = []
+        for name, url in urls.items():
+            health = json.loads(_http(url, "/healthz")[1])
+            stall = health["compiles"] - compiles_before[name]
+            per_replica.append({
+                "replica": name,
+                "compiles_before_flood": compiles_before[name],
+                "mid_flood_compiles": stall,
+                "weight_generation": health["weight_generation"],
+                "warm_buckets": sorted(health["warm_pool"]),
+                "warm_predicts": all(
+                    b["predict"] for b in health["warm_pool"].values()
+                ),
+            })
+            if stall:
+                raise RuntimeError(
+                    f"{name} ate {stall} mid-flood compile(s) for "
+                    "pre-declared buckets — the warm pool is leaking "
+                    f"(before={compiles_before[name]}, "
+                    f"after={health['compiles']})"
+                )
+            if len(health["warm_pool"]) != len(buckets):
+                raise RuntimeError(
+                    f"{name} warm pool holds {sorted(health['warm_pool'])}, "
+                    f"declared {len(buckets)} buckets"
+                )
+        if swap_mid_flood:
+            flipped = [r for r in per_replica
+                       if r["weight_generation"] == 1]
+            if len(flipped) != replicas or not all(
+                v.get("state") in ("ok", "noop")
+                for v in swap_results.values()
+            ):
+                raise RuntimeError(
+                    f"mid-flood swap did not flip every replica: "
+                    f"{swap_results}"
+                )
+
+        return {
+            "metric": MIXED_METRIC,
+            "value": round(requests / elapsed, 2),
+            "unit": "renders/sec",
+            "replicas": replicas, "images": images, "requests": requests,
+            "concurrency": concurrency,
+            "buckets": [list(b) for b in buckets],
+            "engine": "fake",
+            "elapsed_s": round(elapsed, 2),
+            "router_p50_ms": round(
+                1e3 * float(np.percentile(latencies, 50)), 1),
+            "router_p95_ms": round(
+                1e3 * float(np.percentile(latencies, 95)), 1),
+            "mid_flood_compiles": 0,  # the gate above enforces it
+            "swap_mid_flood": swap_mid_flood,
+            "per_replica": per_replica,
+            "note": (
+                "mixed-(H,W,S) skew trace through router+replica HTTP; "
+                "per-bucket AOT warm pools pre-built, compile counter "
+                "asserted FLAT across the flood and a mid-flood hot swap"
+            ),
+        }
+    finally:
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()
+        try:
+            fleet_srv.shutdown()
+            fleet_srv.server_close()
+            fleet.close()
+        except NameError:
+            pass
+        for app in apps:
+            app.close()
+
+
 def _append_ledger_rows(result: dict, compare: dict | None,
                         args, compare_tier: str | None = None) -> list[dict]:
     """The dedicated fleet stream + one tier-keyed economics stream per
@@ -540,11 +782,80 @@ def main() -> None:
     ap.add_argument("--no-peer-fetch", action="store_true")
     ap.add_argument("--no-compare-tiers", action="store_true",
                     help="skip the fp32-vs-tier economics pass")
+    ap.add_argument("--mixed-bucket", action="store_true",
+                    help="run the mixed-(H,W,S) heterogeneous-traffic "
+                    "scenario instead of the homogeneous trace: per-bucket "
+                    "AOT warm pools, interleaved shapes in one skew trace, "
+                    "mid-flood hot swap, compile counter asserted flat "
+                    "(dedicated fleet_mixed_bucket ledger stream)")
+    ap.add_argument("--zoo", action="store_true",
+                    help="with --mixed-bucket: use the pretrained-zoo "
+                    "capability-envelope shapes (RealEstate10K 256x384x64, "
+                    "KITTI 256x768x64, ... — data/conformance/contract.py "
+                    "ZOO_BUCKETS) instead of the bench-sized defaults; "
+                    "production-sized slabs, noticeably slower")
     args = ap.parse_args()
 
     from mine_tpu.utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
+
+    if args.mixed_bucket:
+        # the mixed-bucket scenario is fake-engine fp32 by construction —
+        # a tier/cache-economics flag riding along would be silently
+        # ignored and its ledger row mislabeled; refuse instead
+        ignored = [
+            flag for flag, is_default in (
+                ("--real", not args.real),
+                ("--tier", args.tier == "fp32"),
+                ("--prune-eps", args.prune_eps is None),
+                ("--cache-mb", args.cache_mb == 2048),
+                ("--no-peer-fetch", not args.no_peer_fetch),
+            ) if not is_default
+        ]
+        if ignored:
+            ap.error(
+                f"--mixed-bucket does not support {', '.join(ignored)}: "
+                "the heterogeneous-traffic scenario runs fake-engine fp32 "
+                "(its gate is the compile counter, not cache economics)"
+            )
+        buckets = None
+        if args.zoo:
+            from mine_tpu.data.conformance.contract import ZOO_BUCKETS
+
+            buckets = ZOO_BUCKETS
+        result = run_mixed_bucket(
+            replicas=args.replicas, images=args.images,
+            requests=args.requests, concurrency=args.concurrency,
+            buckets=buckets,
+        )
+        try:
+            import jax
+
+            from mine_tpu.obs import ledger
+
+            row = ledger.append_bench_row({
+                "metric": MIXED_METRIC, "value": result["value"],
+                "unit": "renders/sec", "higher_is_better": True,
+                "p50_ms": result["router_p50_ms"],
+                "p95_ms": result["router_p95_ms"],
+                "device": jax.devices()[0].device_kind,
+                "backend": jax.default_backend(),
+            }, workload={
+                "replicas": args.replicas, "images": args.images,
+                "requests": args.requests,
+                "concurrency": args.concurrency,
+                "engine": "fake",
+                "buckets": "+".join(
+                    "x".join(str(v) for v in b) for b in result["buckets"]
+                ),
+            })
+            if row is not None:
+                result["ledger_rows"] = 1
+        except Exception as exc:  # noqa: BLE001 - number outranks ledger
+            print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+        print(json.dumps(result))
+        return
 
     from mine_tpu.serving.compress import DEFAULT_PRUNE_EPS
 

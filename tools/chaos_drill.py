@@ -71,8 +71,16 @@ pod runs; slow, run explicitly or via --half all):
               uninterrupted 2-host reference (PR 4's islice-resume proof,
               extended across process boundaries).
 
+Datasets half (--half datasets, NOT in 'all' — nine configs x three XLA
+compiles is its own wall-clock budget): the full dataset-conformance
+matrix (mine_tpu/data/conformance/) — every shipped config's loader
+driven through contract checks + the train -> eval -> serve product CLIs
+against its hermetic fixture; the verdict names each config's stage
+outcomes. `tools/conformance_run.py` is the standalone spelling.
+
 Usage:
-  python tools/chaos_drill.py [--half training|serving|fleet|multihost|all]
+  python tools/chaos_drill.py [--half training|serving|fleet|multihost|
+                               datasets|all]
                               [--workdir DIR] [--no-exact] [--steps N]
 """
 
@@ -940,12 +948,51 @@ def multihost_half(workdir: str, timeout_s: float) -> dict:
     return result
 
 
+def datasets_half(workdir: str, timeout_s: float) -> dict:
+    """Dataset-conformance half: the full config matrix through the
+    conformance runner (mine_tpu/data/conformance/) — every shipped
+    config's loader proven against its hermetic fixture end to end
+    (contract checks + the train -> eval -> serve product CLIs). The
+    drill verdict carries each config's stage outcomes; slow (one XLA
+    compile per stage per config), so it is an explicit --half, not part
+    of 'all'."""
+    from mine_tpu.data.conformance.runner import run_matrix
+
+    summary = run_matrix(os.path.join(workdir, "conformance"),
+                         timeout_s=timeout_s)
+    return {
+        "ok": summary["ok"],
+        "configs_checked": summary["configs_checked"],
+        "configs_ok": summary["configs_ok"],
+        "per_config": {
+            r["config"]: {
+                "ok": r["ok"],
+                **{s: bool(res.get("ok"))
+                   for s, res in r["stages"].items()},
+            }
+            for r in summary["results"]
+        },
+        "failures": [
+            {"config": r["config"],
+             "stages": {s: res for s, res in r["stages"].items()
+                        if not res.get("ok")}}
+            for r in summary["results"] if not r["ok"]
+        ],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--half",
                         choices=("training", "serving", "fleet",
-                                 "multihost", "all"),
-                        default="all")
+                                 "multihost", "datasets", "all"),
+                        default="all",
+                        help="'datasets' sweeps the full dataset-"
+                        "conformance matrix (train/eval/serve per config — "
+                        "mine_tpu/data/conformance/); like multihost it is "
+                        "slow, but unlike multihost it stays OUT of 'all': "
+                        "nine configs x three XLA compiles is its own "
+                        "budget, run it explicitly")
     parser.add_argument("--workdir", default=None,
                         help="scratch dir (default: a fresh tempdir)")
     parser.add_argument("--steps", type=int, default=6,
@@ -977,6 +1024,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.half in ("multihost", "all"):
             verdict["multihost"] = multihost_half(workdir, args.timeout_s)
             ok = ok and verdict["multihost"]["ok"]
+        if args.half == "datasets":
+            verdict["datasets"] = datasets_half(workdir, args.timeout_s)
+            ok = ok and verdict["datasets"]["ok"]
         # final step: the perf regression gate (obs/ledger.py, same verdict
         # `python tools/perf_ledger.py check` prints standalone) — a drill
         # that survives its faults but ships a perf regression still fails

@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Dataset-conformance CLI: sweep the shipped config matrix through the
+conformance runner (mine_tpu/data/conformance/) and emit ONE JSON verdict
+line (bench.py/chaos_drill.py discipline) carrying every per-config
+verdict; `--out DIR` additionally writes each config's verdict to its own
+`<config>.json`.
+
+  python tools/conformance_run.py                       # all nine, full rung
+  python tools/conformance_run.py --stages contract     # compile-free, ~secs
+  python tools/conformance_run.py --configs realestate,kitti_raw
+  python tools/conformance_run.py --out workspace/conformance
+
+Stages: `contract` (in-process, compile-free batch/geometry/host-slice
+checks) then `train`/`eval`/`serve` — the config driven through the REAL
+product CLIs (`mine_tpu.train` / `mine_tpu.evaluate` /
+`mine_tpu.serving.server`) against its hermetic fixture, each a
+subprocess on CPU. The full rung costs XLA compiles: minutes per config
+on a 2-core box. Exit code 0 iff every selected config passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--configs", default=None,
+        help="comma-separated shipped config names (default: the whole "
+        "matrix, data/conformance/contract.py all_config_names)",
+    )
+    parser.add_argument(
+        "--stages", default="contract,train,eval,serve",
+        help="comma-separated stage subset; 'contract' alone is the "
+        "compile-free rung",
+    )
+    parser.add_argument(
+        "--workdir", default=None,
+        help="fixtures + per-config workspaces land here (default: a "
+        "fresh temp dir)",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="directory for per-config verdict JSON files (optional; the "
+        "one summary line always prints)",
+    )
+    parser.add_argument("--timeout-s", type=float, default=900.0,
+                        help="per-CLI-subprocess timeout")
+    args = parser.parse_args(argv)
+
+    from mine_tpu.utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
+
+    from mine_tpu.data.conformance.contract import all_config_names
+    from mine_tpu.data.conformance.runner import run_matrix
+
+    names = (tuple(n for n in args.configs.split(",") if n)
+             if args.configs else all_config_names())
+    stages = tuple(s for s in args.stages.split(",") if s)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="mine_conformance_")
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+
+    def on_verdict(verdict: dict) -> None:
+        # per-config progress to stderr (stdout stays the one JSON line)
+        stage_bits = " ".join(
+            f"{s}={'ok' if r.get('ok') else 'FAIL'}"
+            for s, r in verdict["stages"].items()
+        )
+        print(f"# {verdict['config']}: {stage_bits}", file=sys.stderr)
+        if args.out:
+            with open(os.path.join(args.out,
+                                   verdict["config"] + ".json"), "w") as fh:
+                json.dump(verdict, fh, indent=2)
+
+    summary = run_matrix(workdir, config_names=names, stages=stages,
+                         timeout_s=args.timeout_s, on_verdict=on_verdict)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except BaseException as exc:  # noqa: BLE001 - emit-then-exit contract
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "dataset_conformance", "ok": False,
+            "error": f"{type(exc).__name__}: {exc}"[:2000],
+        }))
+        raise SystemExit(1)
