@@ -1,0 +1,48 @@
+"""Static analysis: the repo's prose invariants as machine-checked rules.
+
+Fourteen PRs of review kept re-catching the same defect classes by hand —
+backend probes firing before multi-host bring-up (PR 12/13), host syncs
+inside jitted regions, serving state touched off-lock, config/doc drift.
+This package turns those disciplines into an AST lint engine (stdlib
+`ast`, compile-free, no jax import) with a checker registry, a checked-in
+waiver baseline (`baseline.jsonl`, every waiver carries a reason), and a
+CI runner (`tools/lint_run.py`) that emits one JSON verdict line and
+exits nonzero on any un-waived finding.
+
+Layout:
+  engine.py    Finding / Module / Repo scaffolding, the checker base
+               class, waiver matching, repo scanning
+  checkers.py  the shipped rules (REGISTRY) — each ~50 LoC on the engine
+
+Adding a rule: subclass `Checker` in checkers.py, implement
+`check_module` (per-file) and/or `check_repo` (cross-file), append to
+REGISTRY, add positive+negative fixtures under tests/fixtures/lint/, and
+a row to README's lint-rules table (drift-tested both directions).
+"""
+
+from mine_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    Module,
+    Repo,
+    Waiver,
+    apply_baseline,
+    load_baseline,
+    run,
+    scan_repo,
+)
+from mine_tpu.analysis.checkers import REGISTRY, all_rule_ids
+
+__all__ = [
+    "Checker",
+    "Finding",
+    "Module",
+    "Repo",
+    "Waiver",
+    "REGISTRY",
+    "all_rule_ids",
+    "apply_baseline",
+    "load_baseline",
+    "run",
+    "scan_repo",
+]

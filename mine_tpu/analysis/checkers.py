@@ -1,0 +1,528 @@
+"""The shipped lint rules (REGISTRY at the bottom).
+
+Each rule mechanizes a discipline this repo already paid to learn by
+hand-review; the `motivation` attr names the PR whose bug motivates it,
+and README's "Static analysis" rule table is drift-tested against these
+class attrs in both directions (tests/test_lint.py)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from mine_tpu.analysis.engine import (
+    Checker,
+    Finding,
+    Module,
+    Repo,
+    dotted,
+    importers_of,
+    walk_scoped,
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _enclosing_func(stack: tuple[ast.AST, ...]) -> str:
+    for node in reversed(stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node.name
+        if isinstance(node, ast.Lambda):
+            return "<lambda>"
+    return "<module>"
+
+
+# -- 1. backend-touch-at-import ------------------------------------------------
+
+# Exact jax APIs whose first call initializes (or hangs on) the backend,
+# plus prefix families that allocate arrays. `import jax` is free; the
+# first DEVICE touch is not — and before multi-host bring-up it is fatal
+# (jax.distributed.initialize only works on an untouched backend).
+_BACKEND_CALLS = frozenset({
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.device_put", "jax.default_backend",
+    "jax.process_index", "jax.process_count", "jax.live_arrays",
+})
+_BACKEND_PREFIXES = ("jnp.", "jax.numpy.", "jax.random.", "jax.lax.")
+
+
+class BackendTouchAtImport(Checker):
+    rule_id = "backend-touch-at-import"
+    catches = ("`jax.devices()` / `device_put` / any `jnp.`/`jax.random.` "
+               "call reachable at module import time (module or class "
+               "scope, decorators, default argument values)")
+    motivation = ("PR 12's `honor_jax_platforms` probe initialized the "
+                  "backend before multi-host bring-up; PR 13's router "
+                  "rule: never probe a backend into existence")
+
+    def _is_touch(self, call: ast.Call) -> str:
+        name = dotted(call.func)
+        if name in _BACKEND_CALLS or name.startswith(_BACKEND_PREFIXES):
+            return name
+        return ""
+
+    def _importers(self, repo: Repo) -> dict[str, set[str]]:
+        # one graph build per run, not per module (the hook is per-file)
+        cached = getattr(self, "_importers_cache", None)
+        if cached is None or cached[0] is not repo:
+            cached = (repo, importers_of(repo))
+            self._importers_cache = cached
+        return cached[1]
+
+    def check_module(self, module: Module, repo: Repo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        # import-time code runs for EVERY importer, so the finding names
+        # the blast radius: how many corpus modules pull this one in
+        n_importers = len(self._importers(repo).get(module.path, ()))
+        radius = (f" ({n_importers} corpus modules import this one)"
+                  if n_importers else "")
+
+        def scan(node: ast.AST, import_reachable: bool) -> None:
+            if isinstance(node, ast.Call) and import_reachable:
+                name = self._is_touch(node)
+                if name:
+                    findings.append(Finding(
+                        self.rule_id, module.path, node.lineno, name,
+                        f"`{name}(...)` runs at import time{radius} — the "
+                        "first backend touch must stay behind an explicit "
+                        "entry-point guard (utils/platform.py), never in "
+                        "module scope",
+                    ))
+            if isinstance(node, _FUNC_NODES):
+                # decorators and default values evaluate at def time
+                # (import time when the def itself is import-reachable);
+                # the body only runs when called
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        scan(dec, import_reachable)
+                for default in (*node.args.defaults, *node.args.kw_defaults):
+                    if default is not None:
+                        scan(default, import_reachable)
+                for child in node.body if isinstance(node.body, list) else [node.body]:
+                    scan(child, False)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, import_reachable)
+
+        scan(module.tree, True)
+        return findings
+
+
+# -- 2. host-sync-in-traced ----------------------------------------------------
+
+# wrapper -> indices of its function-valued arguments
+_TRACE_WRAPPERS: dict[str, tuple[int, ...]] = {
+    "jax.jit": (0,), "jit": (0,), "pjit": (0,),
+    "jax.checkpoint": (0,), "checkpoint": (0,), "jax.remat": (0,),
+    "jax.grad": (0,), "jax.value_and_grad": (0,), "jax.vmap": (0,),
+    "shard_map": (0,), "jax.lax.scan": (0,), "lax.scan": (0,),
+    "jax.lax.map": (0,), "lax.map": (0,),
+    "jax.lax.cond": (1, 2), "lax.cond": (1, 2),
+    "jax.lax.while_loop": (0, 1), "lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,), "lax.fori_loop": (2,),
+}
+_TRACE_DECORATOR_RE = re.compile(
+    r"(?:^|[.(\s])(?:jit|pjit|shard_map|remat)\b|jax\.checkpoint\b"
+)
+# host-synchronizing operations: each forces device->host transfer (or
+# would raise TracerError at trace time — either way it does not belong
+# syntactically inside a traced function)
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+_SYNC_CALLS = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get", "device_get",
+})
+
+
+class HostSyncInTraced(Checker):
+    rule_id = "host-sync-in-traced"
+    catches = ("`.item()` / `np.asarray` / `jax.device_get` / "
+               "`block_until_ready` syntactically inside functions handed "
+               "to `jit` / `scan` / `shard_map` / `checkpoint` / `grad`")
+    motivation = ("the streaming-compositor and train-step hot paths (PR 5"
+                  "-7) are only fast because nothing inside them "
+                  "synchronizes the host; a stray .item() is a silent "
+                  "per-step device flush")
+
+    def _traced_functions(self, module: Module) -> list[ast.AST]:
+        by_name: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        traced: dict[int, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _TRACE_DECORATOR_RE.search(ast.unparse(dec)):
+                        traced[id(node)] = node
+            elif isinstance(node, ast.Call):
+                indices = _TRACE_WRAPPERS.get(dotted(node.func))
+                if indices is None:
+                    continue
+                for i in indices:
+                    if i >= len(node.args):
+                        continue
+                    arg = node.args[i]
+                    if isinstance(arg, ast.Lambda):
+                        traced[id(arg)] = arg
+                    elif isinstance(arg, ast.Name):
+                        for fn in by_name.get(arg.id, ()):
+                            traced[id(fn)] = fn
+        return list(traced.values())
+
+    def check_module(self, module: Module, repo: Repo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        seen: set[int] = set()
+        for fn in self._traced_functions(module):
+            fn_name = getattr(fn, "name", "<lambda>")
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    op = ""
+                    name = dotted(node.func)
+                    if name in _SYNC_CALLS:
+                        op = name
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in _SYNC_METHODS):
+                        op = f".{node.func.attr}()"
+                    elif (name in ("float", "int", "bool")
+                          and len(node.args) == 1
+                          and not isinstance(node.args[0], ast.Constant)):
+                        # float(x)/int(x) on a traced array is a host sync
+                        # (concrete) or a TracerError (abstract); either
+                        # way it does not belong inside the traced region
+                        op = f"{name}()"
+                    if op:
+                        seen.add(id(node))
+                        findings.append(Finding(
+                            self.rule_id, module.path, node.lineno,
+                            f"{fn_name}:{op}",
+                            f"`{op}` inside traced `{fn_name}` forces a "
+                            "host sync (or a TracerError) — hoist it out "
+                            "of the jitted region",
+                        ))
+        return findings
+
+
+# -- 3. lock-discipline --------------------------------------------------------
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+class LockDiscipline(Checker):
+    rule_id = "lock-discipline"
+    catches = ("attributes declared `# guarded-by: <lock>` read or written "
+               "outside a `with self.<lock>` block (methods named "
+               "`*_locked` and `__init__`/`__post_init__` are exempt: "
+               "construction and called-with-lock-held helpers)")
+    motivation = ("PR 8's fleet ring and PR 6's tracer ring are only "
+                  "correct because every touch holds the lock; an "
+                  "off-lock read is a torn-snapshot bug waiting for load")
+
+    def _guarded_attrs(self, cls: ast.ClassDef, module: Module
+                       ) -> dict[str, str]:
+        guarded: dict[str, str] = {}
+        for node in ast.walk(cls):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                m = _GUARDED_RE.search(module.line_text(node.lineno))
+                if m:
+                    guarded[target.attr] = m.group(1)
+        return guarded
+
+    def check_module(self, module: Module, repo: Repo) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for cls in [n for n in ast.walk(module.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            guarded = self._guarded_attrs(cls, module)
+            if not guarded:
+                continue
+
+            def on_node(node: ast.AST, stack: tuple[ast.AST, ...],
+                        cls: ast.ClassDef = cls) -> None:
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                        and node.attr in guarded):
+                    return
+                method = _enclosing_func(stack)
+                if method in ("__init__", "__post_init__") or \
+                        method.endswith("_locked"):
+                    return
+                lock = guarded[node.attr]
+                want = f"self.{lock}"
+                for anc in stack:
+                    if isinstance(anc, (ast.With, ast.AsyncWith)) and any(
+                        dotted(item.context_expr) == want
+                        for item in anc.items
+                    ):
+                        return
+                findings.append(Finding(
+                    self.rule_id, module.path, node.lineno,
+                    f"{cls.name}.{method}.{node.attr}",
+                    f"`self.{node.attr}` (guarded-by {lock}) touched in "
+                    f"`{method}` outside `with {want}` — take the lock or "
+                    "rename the helper `*_locked`",
+                ))
+
+            walk_scoped(cls, on_node)
+        return findings
+
+
+# -- 4. error-taxonomy ---------------------------------------------------------
+
+
+class ErrorTaxonomy(Checker):
+    rule_id = "error-taxonomy"
+    catches = ("`raise Exception(...)` instead of a named error, bare "
+               "`except:`, message-less `assert`, and `except Exception:` "
+               "handlers that swallow without logging/counting/re-raising "
+               "(mine_tpu/ only)")
+    motivation = ("PR 4/8 built the named-error + counter taxonomy "
+                  "(UnknownDatasetError, ChaosFault, breaker metrics) so "
+                  "failures are attributable; a silent `pass` handler "
+                  "un-counts exactly the failures the SLO layer bills")
+
+    def check_module(self, module: Module, repo: Repo) -> Iterable[Finding]:
+        if not module.path.startswith("mine_tpu/"):
+            return ()
+        findings: list[Finding] = []
+
+        def on_node(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+            func = _enclosing_func(stack)
+            if isinstance(node, ast.Raise):
+                exc = node.exc
+                name = ""
+                if isinstance(exc, ast.Call):
+                    name = dotted(exc.func)
+                elif exc is not None:
+                    name = dotted(exc)
+                if name in ("Exception", "BaseException"):
+                    findings.append(Finding(
+                        self.rule_id, module.path, node.lineno,
+                        f"raise:{func}",
+                        f"`raise {name}` in `{func}` — raise a named "
+                        "error class so callers and counters can "
+                        "discriminate it",
+                    ))
+            elif isinstance(node, ast.Assert) and node.msg is None:
+                findings.append(Finding(
+                    self.rule_id, module.path, node.lineno,
+                    f"assert:{func}",
+                    f"message-less `assert` in `{func}` — when it fires "
+                    "the operator learns nothing; add a message or raise "
+                    "a named error",
+                ))
+            elif isinstance(node, ast.ExceptHandler):
+                reraises = any(isinstance(n, ast.Raise)
+                               for n in ast.walk(node))
+                if node.type is None and not reraises:
+                    findings.append(Finding(
+                        self.rule_id, module.path, node.lineno,
+                        f"bare-except:{func}",
+                        f"bare `except:` in `{func}` catches SystemExit/"
+                        "KeyboardInterrupt — name the exception class",
+                    ))
+                elif (dotted(node.type) in ("Exception", "BaseException")
+                      if node.type is not None else False):
+                    swallow = all(
+                        isinstance(stmt, (ast.Pass, ast.Continue, ast.Break))
+                        or (isinstance(stmt, ast.Expr)
+                            and isinstance(stmt.value, ast.Constant))
+                        for stmt in node.body
+                    )
+                    if swallow:
+                        findings.append(Finding(
+                            self.rule_id, module.path, node.lineno,
+                            f"swallow:{func}",
+                            f"`except {dotted(node.type)}: pass` in "
+                            f"`{func}` swallows the failure uncounted — "
+                            "log it, count it, or re-raise",
+                        ))
+
+        walk_scoped(module.tree, on_node)
+        return findings
+
+
+# -- 5. config-knob-drift ------------------------------------------------------
+
+_CFG_ROOT_RE = re.compile(r"(?:^|[._])(?:cfg|config)$")
+
+
+class ConfigKnobDrift(Checker):
+    rule_id = "config-knob-drift"
+    catches = ("a `cfg.<group>.<name>` access with no configs/default.yaml "
+               "key (undocumented knob), and a yaml key no code reads "
+               "(dead knob) — the static twin of the README-table guards")
+    motivation = ("PR 13/14 added runtime drift guards for metric families "
+                  "and the dataset matrix after knobs and docs diverged "
+                  "silently; config keys had no guard at all")
+
+    def check_repo(self, repo: Repo) -> Iterable[Finding]:
+        yaml_keys = repo.yaml_keys()
+        if not yaml_keys:
+            return ()
+        groups = {k.split(".", 1)[0] for k in yaml_keys}
+        findings: list[Finding] = []
+        read_attrs: set[str] = set()
+        read_strings: list[str] = []
+
+        for module in repo.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Attribute):
+                    read_attrs.add(node.attr)
+                    # direction A: cfg.<group>.<name> must be a yaml key
+                    inner = node.value
+                    if (isinstance(inner, ast.Attribute)
+                            and inner.attr in groups
+                            and _CFG_ROOT_RE.search(dotted(inner.value))
+                            and not node.attr.startswith("_")):
+                        key = f"{inner.attr}.{node.attr}"
+                        if key not in yaml_keys:
+                            findings.append(Finding(
+                                self.rule_id, module.path, node.lineno, key,
+                                f"`{key}` is read here but has no "
+                                f"{repo.yaml_file()} entry — document the "
+                                "knob (with its default) or retire it",
+                            ))
+                elif (isinstance(node, ast.Constant)
+                      and isinstance(node.value, str)):
+                    read_strings.append(node.value)
+
+        # direction B: every yaml key is read somewhere — by attribute
+        # name (covers aliased group objects: `res.breaker_reset_s`), by
+        # getattr/replace string (covers `getattr(cfg.parallel, "rules")`
+        # and `cfg.replace(**{"mpi.fix_disparity": ...})`)
+        blob = "\x00".join(read_strings)
+        for key, line in sorted(yaml_keys.items()):
+            name = key.split(".", 1)[1]
+            if name in read_attrs or name in blob or key in blob:
+                continue
+            findings.append(Finding(
+                self.rule_id, repo.yaml_file(), line, key,
+                f"config key `{key}` is never read by any scanned code — "
+                "dead knob: delete it or wire it up",
+            ))
+        return findings
+
+
+# -- 6. chaos-kind-drift -------------------------------------------------------
+
+_CHAOS_BEGIN = "<!-- chaos-kinds:begin -->"
+_CHAOS_END = "<!-- chaos-kinds:end -->"
+_CHAOS_DOC_RE = re.compile(r"`([a-z0-9_]+)@")
+_SEAM_NAMES = frozenset({"should", "maybe_raise"})
+
+
+class ChaosKindDrift(Checker):
+    rule_id = "chaos-kind-drift"
+    catches = ("a `MINE_TPU_FAULTS` kind fired at a seam but absent from "
+               "chaos.KINDS or README's chaos-kind table, a registered "
+               "kind the table does not document, and a documented kind "
+               "the registry no longer knows")
+    motivation = ("PR 12/13 grew the fault grammar PR by PR; the drill's "
+                  "coverage story depends on the kind table, the seams, "
+                  "and the docs describing the same set")
+
+    def _registry(self, repo: Repo) -> tuple[dict[str, int], str]:
+        """KINDS keys -> lineno, plus the defining module's path."""
+        for module in repo.modules:
+            for node in ast.walk(module.tree):
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.target:
+                    targets = [node.target]
+                else:
+                    continue
+                if (any(isinstance(t, ast.Name) and t.id == "KINDS"
+                        for t in targets)
+                        and isinstance(node.value, ast.Dict)):
+                    kinds = {
+                        k.value: k.lineno
+                        for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                    if kinds:
+                        return kinds, module.path
+        return {}, ""
+
+    def check_repo(self, repo: Repo) -> Iterable[Finding]:
+        kinds, kinds_path = self._registry(repo)
+        if not kinds:
+            return ()  # fixture repos without a registry: nothing to check
+        findings: list[Finding] = []
+
+        for module in repo.modules:
+            if module.path == kinds_path:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if name.rsplit(".", 1)[-1] not in _SEAM_NAMES:
+                    continue
+                if not (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                kind = node.args[0].value
+                if kind not in kinds:
+                    findings.append(Finding(
+                        self.rule_id, module.path, node.lineno, kind,
+                        f"chaos seam fires unknown kind `{kind}` — not in "
+                        f"{kinds_path} KINDS, so no schedule can ever "
+                        "trigger it",
+                    ))
+
+        readme = repo.readme_text()
+        readme_file = repo.readme_file()
+        if readme is None or _CHAOS_BEGIN not in readme \
+                or _CHAOS_END not in readme:
+            findings.append(Finding(
+                self.rule_id, readme_file or "README.md", 1,
+                "chaos-kinds-markers",
+                f"README lacks the marker-bounded chaos-kind table "
+                f"({_CHAOS_BEGIN} .. {_CHAOS_END})",
+            ))
+            return findings
+        begin = readme.index(_CHAOS_BEGIN)
+        table = readme[begin:readme.index(_CHAOS_END)]
+        table_line = readme[:begin].count("\n") + 1
+        documented = set(_CHAOS_DOC_RE.findall(table))
+        for kind in sorted(set(kinds) - documented):
+            findings.append(Finding(
+                self.rule_id, kinds_path, kinds[kind], kind,
+                f"chaos kind `{kind}` is registered but missing from "
+                "README's chaos-kind table",
+            ))
+        for kind in sorted(documented - set(kinds)):
+            findings.append(Finding(
+                self.rule_id, readme_file, table_line, kind,
+                f"README's chaos-kind table documents `{kind}` but the "
+                "registry no longer knows it — delete the stale row",
+            ))
+        return findings
+
+
+REGISTRY: tuple[Checker, ...] = (
+    BackendTouchAtImport(),
+    HostSyncInTraced(),
+    LockDiscipline(),
+    ErrorTaxonomy(),
+    ConfigKnobDrift(),
+    ChaosKindDrift(),
+)
+
+
+def all_rule_ids() -> tuple[str, ...]:
+    return tuple(c.rule_id for c in REGISTRY)

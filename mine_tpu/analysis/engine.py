@@ -1,0 +1,349 @@
+"""Lint-engine scaffolding: findings, repo scanning, waiver matching.
+
+Stdlib-only on purpose (`ast` + `json` + `pathlib`): the runner must be
+usable as a pre-commit / CI gate without initializing jax, and the tier-1
+smoke that runs it over the whole tree must cost milliseconds, not a
+backend bring-up. Checkers (analysis/checkers.py) build on three pieces
+here:
+
+  Module   one parsed source file (path, source lines, AST)
+  Repo     the scanned corpus + the non-Python inputs some rules need
+           (configs/default.yaml, README.md) — injectable, so fixture
+           mini-repos under tests/fixtures/lint/ exercise every rule
+           without touching the real tree
+  Checker  the registry contract: `check_module` runs once per file,
+           `check_repo` once per run (cross-file rules: drift tables)
+
+Waivers: `baseline.jsonl`, one JSON object per line with a mandatory
+human reason. A waiver matches findings by (rule_id, file, symbol) — the
+`symbol` is each rule's stable anchor (an attribute path, a config key, a
+seam name), NOT a line number, so waivers survive unrelated edits above
+them. A waiver that matches nothing is reported stale: delete the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+# findings whose file could not even be parsed carry this rule id; it is
+# registered in checkers.REGISTRY order-independently (no Checker class —
+# a file that does not parse fails every discipline at once)
+PARSE_RULE_ID = "parse-error"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site.
+
+    `symbol` is the waiver anchor: stable under line drift (two findings
+    with one symbol in one file are waived by one baseline row — they are
+    the same decision)."""
+
+    rule_id: str
+    file: str  # repo-relative posix path
+    line: int
+    symbol: str
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule_id, self.file, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.rule_id}:{self.file}:{self.line}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule_id": self.rule_id, "file": self.file, "line": self.line,
+            "symbol": self.symbol, "message": self.message,
+        }
+
+
+@dataclass
+class Module:
+    """One parsed source file."""
+
+    path: str  # repo-relative posix
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        """1-indexed source line ('' past EOF)."""
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+
+@dataclass
+class Repo:
+    """The scanned corpus plus the non-Python rule inputs.
+
+    Tests build tiny Repos by hand (fixture modules + a fixture yaml +
+    a fixture README); the runner builds the real one via scan_repo()."""
+
+    root: Path
+    modules: list[Module]
+    yaml_path: Path | None = None
+    readme_path: Path | None = None
+    parse_failures: list[Finding] = field(default_factory=list)
+
+    def yaml_keys(self) -> dict[str, int]:
+        """Flat dot-key -> 1-indexed line of configs/default.yaml."""
+        keys: dict[str, int] = {}
+        if self.yaml_path is None or not self.yaml_path.exists():
+            return keys
+        for i, line in enumerate(
+            self.yaml_path.read_text().splitlines(), start=1
+        ):
+            stripped = line.split("#", 1)[0].strip()
+            if ":" in stripped:
+                key = stripped.split(":", 1)[0].strip()
+                if "." in key and not key.startswith("."):
+                    keys[key] = i
+        return keys
+
+    def yaml_file(self) -> str:
+        return _rel(self.yaml_path, self.root) if self.yaml_path else ""
+
+    def readme_text(self) -> str | None:
+        if self.readme_path is None or not self.readme_path.exists():
+            return None
+        return self.readme_path.read_text()
+
+    def readme_file(self) -> str:
+        return _rel(self.readme_path, self.root) if self.readme_path else ""
+
+
+class Checker:
+    """Registry contract. Subclasses set the three class attrs (the README
+    rule table is drift-tested against them) and override one or both
+    hooks. ~50 LoC per rule is the budget; shared walking lives here."""
+
+    rule_id: str = ""
+    catches: str = ""  # one line: what defect class this rule fails on
+    motivation: str = ""  # which past PR's bug this rule mechanizes
+
+    def check_module(self, module: Module, repo: Repo) -> Iterable[Finding]:
+        return ()
+
+    def check_repo(self, repo: Repo) -> Iterable[Finding]:
+        return ()
+
+
+def run(repo: Repo, checkers: Iterable[Checker]) -> list[Finding]:
+    """All findings from all checkers over the repo, stably ordered."""
+    findings: list[Finding] = list(repo.parse_failures)
+    for checker in checkers:
+        for module in repo.modules:
+            findings.extend(checker.check_module(module, repo))
+        findings.extend(checker.check_repo(repo))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule_id, f.symbol))
+    return findings
+
+
+# -- repo scanning -------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "workspace"}
+
+
+def _rel(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def iter_py_files(root: Path, paths: Iterable[str]) -> Iterator[Path]:
+    for entry in paths:
+        p = root / entry
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not _SKIP_DIRS.intersection(f.parts):
+                    yield f
+
+
+def parse_module(path: Path, root: Path) -> Module | Finding:
+    rel = _rel(path, root)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as exc:
+        return Finding(PARSE_RULE_ID, rel, exc.lineno or 1, "syntax",
+                       f"file does not parse: {exc.msg}")
+    return Module(path=rel, source=source, tree=tree)
+
+
+def scan_repo(
+    root: Path,
+    paths: Iterable[str] = ("mine_tpu", "tools", "bench.py"),
+    yaml_rel: str = "mine_tpu/configs/default.yaml",
+    readme_rel: str = "README.md",
+) -> Repo:
+    modules: list[Module] = []
+    failures: list[Finding] = []
+    for f in iter_py_files(root, paths):
+        parsed = parse_module(f, root)
+        if isinstance(parsed, Finding):
+            failures.append(parsed)
+        else:
+            modules.append(parsed)
+    return Repo(
+        root=root, modules=modules,
+        yaml_path=root / yaml_rel, readme_path=root / readme_rel,
+        parse_failures=failures,
+    )
+
+
+# -- waiver baseline -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Waiver:
+    rule_id: str
+    file: str
+    symbol: str
+    reason: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule_id, self.file, self.symbol)
+
+
+def load_baseline(path: Path) -> list[Waiver]:
+    """Parse baseline.jsonl; a waiver without a non-empty reason is a
+    hard error — an unexplained waiver is exactly the prose-invariant rot
+    this subsystem exists to stop."""
+    waivers: list[Waiver] = []
+    if not path.exists():
+        return waivers
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError as exc:
+            raise ValueError(f"{path}:{i}: not JSON: {exc}") from None
+        missing = {"rule_id", "file", "symbol", "reason"} - set(row)
+        if missing:
+            raise ValueError(f"{path}:{i}: waiver missing {sorted(missing)}")
+        if not str(row["reason"]).strip():
+            raise ValueError(f"{path}:{i}: waiver reason must be non-empty")
+        waivers.append(Waiver(row["rule_id"], row["file"], row["symbol"],
+                              row["reason"]))
+    return waivers
+
+
+def apply_baseline(
+    findings: Iterable[Finding], waivers: Iterable[Waiver],
+) -> tuple[list[Finding], list[Finding], list[Waiver]]:
+    """Split findings into (unwaived, waived) and report stale waivers.
+
+    A waiver matches every finding sharing its (rule_id, file, symbol) —
+    symbol-anchored, so it survives line drift; a waiver matching nothing
+    is stale and should be deleted (the baseline only ever shrinks)."""
+    waivers = list(waivers)
+    by_key = {w.key: w for w in waivers}
+    unwaived: list[Finding] = []
+    waived: list[Finding] = []
+    hit: set[tuple[str, str, str]] = set()
+    for f in findings:
+        if f.key in by_key:
+            waived.append(f)
+            hit.add(f.key)
+        else:
+            unwaived.append(f)
+    stale = [w for w in waivers if w.key not in hit]
+    return unwaived, waived, stale
+
+
+# -- import graph --------------------------------------------------------------
+
+
+def import_graph(repo: Repo) -> dict[str, set[str]]:
+    """module path -> set of corpus module paths it imports (absolute
+    imports only — this tree's idiom). `import mine_tpu.serving.engine`
+    resolves to the module file; `import mine_tpu.serving` to the
+    package __init__. Checkers use the REVERSE view ("who imports me")
+    to report the import-time blast radius of a finding; later rules can
+    walk reachability (e.g. what a CLI entry point pulls in before its
+    backend guard runs)."""
+    by_dotted: dict[str, str] = {}
+    for m in repo.modules:
+        dotted_name = m.path[:-3].replace("/", ".")
+        if dotted_name.endswith(".__init__"):
+            dotted_name = dotted_name[: -len(".__init__")]
+        by_dotted[dotted_name] = m.path
+    graph: dict[str, set[str]] = {}
+    for m in repo.modules:
+        edges: set[str] = set()
+        for node in ast.walk(m.tree):
+            names: list[str] = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                # `from pkg.mod import x`: x may be a symbol OR a module
+                names = [node.module] + [
+                    f"{node.module}.{a.name}" for a in node.names
+                ]
+            for name in names:
+                while name:
+                    if name in by_dotted:
+                        edges.add(by_dotted[name])
+                        break
+                    name = name.rpartition(".")[0]
+        edges.discard(m.path)
+        graph[m.path] = edges
+    return graph
+
+
+def importers_of(repo: Repo) -> dict[str, set[str]]:
+    """Reverse import graph: module path -> corpus modules importing it."""
+    reverse: dict[str, set[str]] = {m.path: set() for m in repo.modules}
+    for importer, imported in import_graph(repo).items():
+        for path in imported:
+            reverse.setdefault(path, set()).add(importer)
+    return reverse
+
+
+# -- shared AST helpers (the pieces every checker wants) -----------------------
+
+
+def dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for a Name/Attribute chain; '' for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def walk_scoped(
+    tree: ast.AST,
+    on_node: Callable[[ast.AST, tuple[ast.AST, ...]], None],
+) -> None:
+    """Depth-first walk calling on_node(node, ancestors) — ancestors is
+    the tuple of enclosing AST nodes, outermost first. The generic walk
+    several checkers need (is this call inside a function? inside a
+    `with`? which class?), paid for once here."""
+
+    def visit(node: ast.AST, stack: tuple[ast.AST, ...]) -> None:
+        on_node(node, stack)
+        child_stack = stack + (node,)
+        for child in ast.iter_child_nodes(node):
+            visit(child, child_stack)
+
+    visit(tree, ())

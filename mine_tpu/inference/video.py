@@ -137,7 +137,10 @@ def write_video(frames: np.ndarray, path: str, fps: int = 30) -> str:
     Returns the path actually written (the .mp4, or the PNG directory).
     """
     frames = np.asarray(frames)
-    assert frames.dtype == np.uint8 and frames.ndim == 4 and frames.shape[-1] == 3
+    assert frames.dtype == np.uint8 and frames.ndim == 4 and frames.shape[-1] == 3, (
+        f"write_video wants (N, H, W, 3) uint8 frames, got {frames.dtype} "
+        f"{frames.shape}"
+    )
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     try:
         import cv2

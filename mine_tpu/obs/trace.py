@@ -156,10 +156,10 @@ class Tracer:
         self.on_span = on_span
         self._epoch = time.perf_counter()
         self._lock = threading.RLock()
-        self._spans: deque[Span] = deque(maxlen=self.max_spans)
-        self._dropped = 0
+        self._spans: deque[Span] = deque(maxlen=self.max_spans)  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
         # running (cat, name) -> [count, total_us] since last summary reset
-        self._totals: dict[tuple[str, str], list[float]] = defaultdict(
+        self._totals: dict[tuple[str, str], list[float]] = defaultdict(  # guarded-by: _lock
             lambda: [0.0, 0.0]
         )
         self._local = threading.local()

@@ -127,16 +127,20 @@ class MicroBatcher:
         self.max_queue_requests = int(max_queue_requests)
         self._metrics = metrics
         self._tracer = tracer if tracer is not None else NULL_TRACER
-        self._pending: deque[_Pending] = deque()
+        self._pending: deque[_Pending] = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._stop = False
+        self._stop = False  # guarded-by: _cond
         self._worker: threading.Thread | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "MicroBatcher":
         if self._worker is None or not self._worker.is_alive():
-            self._stop = False
+            # under the condition like every other _stop touch: a restart
+            # racing a concurrent stop() must not interleave the flag flip
+            # with stop()'s drain
+            with self._cond:
+                self._stop = False
             self._worker = threading.Thread(
                 target=self._run, name="mine-serve-batcher", daemon=True
             )

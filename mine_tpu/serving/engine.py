@@ -326,7 +326,7 @@ class RenderEngine:
         self.peak_flops = resolve_peak_flops(
             jax.devices()[0], peak_flops_override
         )
-        self._buckets: dict[BucketSpec, _Bucket] = {}
+        self._buckets: dict[BucketSpec, _Bucket] = {}  # guarded-by: _buckets_lock
         self._buckets_lock = threading.Lock()
 
     # -- weight generations --------------------------------------------------

@@ -305,7 +305,7 @@ class FleetApp:
         self.transport = transport if transport is not None else _urllib_transport
         self.clock = clock
         self._lock = threading.Lock()
-        self._ring = HashRing(list(self.replicas), vnodes=vnodes)
+        self._ring = HashRing(list(self.replicas), vnodes=vnodes)  # guarded-by: _lock
         self._probe_stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
         self._started_at = time.time()

@@ -424,7 +424,10 @@ def loss_fcn_per_scale(
     k_tgt = ops.scale_intrinsics(batch["k_tgt"], scale)
     k_src_inv = ops.inverse_3x3(k_src)
 
-    assert mpi.shape[2] == src_img.shape[1] and mpi.shape[3] == src_img.shape[2]
+    assert mpi.shape[2] == src_img.shape[1] and mpi.shape[3] == src_img.shape[2], (
+        f"MPI spatial dims {mpi.shape[2:4]} != scale-{scale} image dims "
+        f"{src_img.shape[1:3]} — the multi-scale loss must downsample both"
+    )
     mpi_rgb = mpi[..., 0:3]
     mpi_sigma = mpi[..., 3:4]
 

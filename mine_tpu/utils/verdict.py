@@ -1,0 +1,40 @@
+"""The one-JSON-verdict-line-to-stdout contract, in one place.
+
+Every gate CLI in this repo (bench.py, tools/perf_ledger.py,
+tools/conformance_run.py, tools/chaos_drill.py, tools/lint_run.py)
+promises the same thing: human progress goes to stderr, stdout carries
+EXACTLY ONE JSON object line — the verdict — and the exit code follows
+its `ok` field. Three tools had hand-rolled that contract independently;
+this module is the single definition they now share, so the contract
+cannot drift (a second stdout line breaks every `$(tool | tail -1)`
+consumer and the drill's embedded-verdict parsing).
+
+Stdlib-only: importable by pre-backend CLI guards without touching jax.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import traceback
+from typing import Any
+
+
+def emit(verdict: dict[str, Any]) -> int:
+    """Print the verdict as one JSON line on stdout; return the exit code
+    (0 iff verdict["ok"] is truthy) for the caller to raise SystemExit
+    with. Flushes, so the line survives an os._exit watchdog."""
+    sys.stdout.write(json.dumps(verdict) + "\n")
+    sys.stdout.flush()
+    return 0 if verdict.get("ok") else 1
+
+
+def emit_failure(metric: str, exc: BaseException, **extra: Any) -> int:
+    """The emit-then-exit contract for a crashed gate: traceback to
+    stderr for the human, a well-formed failing verdict to stdout for the
+    machine consumer (never a bare stack trace as the only output)."""
+    traceback.print_exc(file=sys.stderr)
+    return emit({
+        "metric": metric, "value": None, "ok": False,
+        "error": f"{type(exc).__name__}: {exc}"[:2000], **extra,
+    })

@@ -1,0 +1,19 @@
+"""NEGATIVE fixture: backend touches behind function bodies stay quiet."""
+import jax
+import jax.numpy as jnp
+
+SHAPE = (1, 128, 128, 3)  # plain constants are fine
+ABSTRACT = None
+
+
+def device_count():
+    return len(jax.devices())  # inside a function body: quiet
+
+
+def make_planes(n):
+    return jnp.linspace(0.0, 1.0, n)  # quiet
+
+
+class Engine:
+    def __init__(self):
+        self.planes = jnp.zeros(8)  # method body: quiet

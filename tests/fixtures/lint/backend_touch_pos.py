@@ -1,0 +1,18 @@
+"""POSITIVE fixture: backend-touch-at-import must fire on every site."""
+import jax
+import jax.numpy as jnp
+
+N_DEVICES = len(jax.devices())  # module scope: fires
+EPS = jnp.float32(1e-6)  # jnp at module scope: fires
+
+
+class Planes:
+    DEFAULT = jnp.linspace(0.0, 1.0, 8)  # class scope: fires
+
+
+def render(x, fallback=jax.local_device_count()):  # default arg: fires
+    return x
+
+
+def _decorate(fn, key=jax.random.PRNGKey(0)):  # default arg: fires
+    return fn

@@ -1,0 +1,6 @@
+"""Fixture chaos registry (stands in for resilience/chaos.py)."""
+
+KINDS: dict[str, str] = {
+    "nan_loss": "step",
+    "sigterm": "step",
+}

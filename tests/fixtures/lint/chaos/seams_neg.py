@@ -1,0 +1,9 @@
+"""NEGATIVE fixture: only registered kinds fired at seams."""
+import chaos
+
+
+def loop(step):
+    chaos.maybe_raise("nan_loss")
+    if chaos.should("sigterm", at=step):
+        return None
+    return step

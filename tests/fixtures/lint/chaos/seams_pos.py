@@ -1,0 +1,9 @@
+"""POSITIVE fixture: fires an unregistered chaos kind at a seam."""
+import chaos
+
+
+def loop(step):
+    chaos.maybe_raise("mystery_fault")  # fires: not in KINDS
+    if chaos.should("nan_loss", at=step):  # registered: quiet
+        return None
+    return step

@@ -1,0 +1,11 @@
+"""NEGATIVE fixture: every access documented, every yaml key read."""
+
+
+def build(cfg):
+    return cfg.model.width * cfg.train.lr
+
+
+def legacy(cfg):
+    # aliased/getattr reads count as reads (the real tree reads
+    # parallel.rules via getattr and fix_disparity via a dot-key string)
+    return getattr(cfg.train, "dead_knob", 0)

@@ -1,0 +1,13 @@
+# POSITIVE fixture: config-knob-drift — an undocumented access, plus a
+# yaml key nothing here reads (naming it in a string would count as a
+# read: getattr/dot-key strings are legitimate consumption).
+
+
+def build(cfg):
+    w = cfg.model.width  # documented: quiet
+    d = cfg.model.depth  # fires: no default.yaml entry
+    return w * d
+
+
+def schedule(self_cfg):
+    return self_cfg.train.lr  # documented: quiet

@@ -1,0 +1,23 @@
+"""NEGATIVE fixture: host syncs OUTSIDE traced functions stay quiet."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(x):
+    return jnp.mean(x) * 2.0  # nothing host-touching: quiet
+
+
+def host_loop(xs):
+    # not traced by anything: .item()/np.asarray here are the NORMAL way
+    # to get values off-device
+    total = 0.0
+    for x in xs:
+        total += float(np.asarray(clean_step(x)).item())
+    return total
+
+
+def untraced_helper(x):
+    x.block_until_ready()  # never handed to jit/scan: quiet
+    return jax.device_get(x)

@@ -1,0 +1,35 @@
+"""POSITIVE fixture: host-sync-in-traced must fire on every marked site."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    loss = jnp.mean(x)
+    return loss.item()  # fires: .item() under @jax.jit
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def partial_decorated(cfg, x):
+    host = np.asarray(x)  # fires: np.asarray under partial(jax.jit)
+    return host
+
+
+def scan_body(carry, x):
+    y = carry + x
+    y.block_until_ready()  # fires: body handed to lax.scan below
+    return y, float(y)  # fires: float() on a traced value
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, jnp.zeros(()), xs)
+
+
+def wrapped(x):
+    return jax.device_get(x)  # fires: wrapped by jax.jit below
+
+
+run_wrapped = jax.jit(wrapped)

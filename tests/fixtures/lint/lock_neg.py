@@ -1,0 +1,27 @@
+"""NEGATIVE fixture: disciplined guarded-by usage stays quiet."""
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._members = []  # guarded-by: _lock
+        self._pending = []  # guarded-by: _cond
+        self.epoch = 0  # NOT annotated: free access
+
+    def add(self, name):
+        with self._lock:
+            self._members.append(name)
+
+    def wait_drain(self):
+        with self._cond:
+            while self._pending:
+                self._cond.wait()
+
+    def _gauge_locked(self):
+        # *_locked convention: documented called-with-lock-held helper
+        return len(self._members)
+
+    def bump(self):
+        self.epoch += 1  # unannotated attr: quiet

@@ -1,0 +1,22 @@
+"""POSITIVE fixture: lock-discipline must fire on off-lock touches."""
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members = []  # guarded-by: _lock
+        self._epoch = 0  # guarded-by: _lock
+
+    def add(self, name):
+        self._members.append(name)  # fires: write without the lock
+
+    def snapshot(self):
+        with self._lock:
+            members = list(self._members)  # quiet: held
+        return members, self._epoch  # fires: _epoch read off-lock
+
+    def wrong_lock(self):
+        other = threading.Lock()
+        with other:
+            return len(self._members)  # fires: not self._lock

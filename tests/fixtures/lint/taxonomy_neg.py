@@ -1,0 +1,36 @@
+"""NEGATIVE fixture: named errors / counted handlers stay quiet."""
+import logging
+
+
+class BadShape(ValueError):
+    pass
+
+
+def validate(x):
+    if x < 0:
+        raise BadShape(f"bad x {x}")  # named class: quiet
+    assert x != 1, f"x must not be 1, got {x}"  # message: quiet
+    return x
+
+
+def reraise(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 - bare but re-raises: quiet
+        raise
+
+
+def counted(fn, metrics):
+    try:
+        return fn()
+    except Exception:
+        metrics.failures += 1  # counted: quiet
+        return None
+
+
+def logged(fn):
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001
+        logging.exception("fn failed")  # logged: quiet
+        return None

@@ -1,0 +1,25 @@
+"""POSITIVE fixture: error-taxonomy must fire on each marked site.
+
+Scanned under a synthetic mine_tpu/ path in the tests (the rule only
+applies to mine_tpu/)."""
+
+
+def validate(x):
+    if x < 0:
+        raise Exception(f"bad x {x}")  # fires: unnamed error class
+    assert x != 1  # fires: message-less assert
+    return x
+
+
+def swallow_all(fn):
+    try:
+        return fn()
+    except:  # noqa: E722 - fires: bare except
+        return None
+
+
+def swallow_silent(fn):
+    try:
+        return fn()
+    except Exception:
+        pass  # fires: swallowed uncounted

@@ -1057,13 +1057,37 @@ def main(argv: list[str] | None = None) -> int:
         else:
             verdict["perf_ledger"] = {"ok": True, "note": "ledger disabled "
                                       "($MINE_TPU_PERF_LEDGER)"}
+        # final step, same pattern as the perf-ledger gate: the static-
+        # analysis suite (mine_tpu/analysis/, same verdict `python
+        # tools/lint_run.py` prints standalone) — a drill that survives
+        # its faults but ships an un-waived invariant violation still
+        # fails. Pure-AST, so the gate costs milliseconds, not a compile.
+        from pathlib import Path
+
+        from mine_tpu import analysis
+
+        lint_repo = analysis.scan_repo(Path(REPO_ROOT))
+        lint_unwaived, lint_waived, lint_stale = analysis.apply_baseline(
+            analysis.run(lint_repo, analysis.REGISTRY),
+            analysis.load_baseline(
+                Path(REPO_ROOT) / "mine_tpu/analysis/baseline.jsonl"),
+        )
+        verdict["static_analysis"] = {
+            "ok": not lint_unwaived and not lint_stale,
+            "unwaived": [f.render() for f in lint_unwaived[:50]],
+            "waived": len(lint_waived),
+            "stale_waivers": [list(w.key) for w in lint_stale],
+        }
+        ok = ok and verdict["static_analysis"]["ok"]
         verdict["value"] = 1.0 if ok else None
         verdict["ok"] = ok
     except Exception as exc:  # noqa: BLE001 - the verdict IS the output
         verdict.update(value=None, ok=False,
                        error=f"{type(exc).__name__}: {exc}")
         ok = False
-    print(json.dumps(verdict))
+    from mine_tpu.utils.verdict import emit
+
+    emit(verdict)
     return 0 if ok else 1
 
 

@@ -86,8 +86,9 @@ def main(argv: list[str] | None = None) -> int:
 
     summary = run_matrix(workdir, config_names=names, stages=stages,
                          timeout_s=args.timeout_s, on_verdict=on_verdict)
-    print(json.dumps(summary))
-    return 0 if summary["ok"] else 1
+    from mine_tpu.utils.verdict import emit
+
+    return emit(summary)
 
 
 if __name__ == "__main__":
@@ -96,11 +97,6 @@ if __name__ == "__main__":
     except SystemExit:
         raise
     except BaseException as exc:  # noqa: BLE001 - emit-then-exit contract
-        import traceback
+        from mine_tpu.utils.verdict import emit_failure
 
-        traceback.print_exc(file=sys.stderr)
-        print(json.dumps({
-            "metric": "dataset_conformance", "ok": False,
-            "error": f"{type(exc).__name__}: {exc}"[:2000],
-        }))
-        raise SystemExit(1)
+        raise SystemExit(emit_failure("dataset_conformance", exc))

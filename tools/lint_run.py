@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""Static-analysis runner: the repo's prose invariants as a CI gate.
+
+Runs every registered checker (mine_tpu/analysis/) over the tree —
+stdlib `ast` only, no jax import, no compile — applies the checked-in
+waiver baseline (mine_tpu/analysis/baseline.jsonl, every waiver carries
+a reason), prints each un-waived finding as `rule_id:file:line: message`
+to stderr, and emits ONE JSON verdict line on stdout (the shared
+bench.py/chaos_drill.py discipline, mine_tpu/utils/verdict.py). Exit 0
+iff no un-waived finding and no stale waiver.
+
+  python tools/lint_run.py                      # the CI gate
+  python tools/lint_run.py --changed main       # only findings on lines
+                                                # touched since `main`
+                                                # (fast pre-commit path)
+  python tools/lint_run.py --json-out out.json  # full findings dump for
+                                                # the drill verdict
+  python tools/lint_run.py --list-rules         # registry, one per line
+
+Waiver workflow: a deliberate finding gets one baseline.jsonl line
+  {"rule_id": ..., "file": ..., "symbol": ..., "reason": "<why>"}
+where `symbol` is printed in the finding dump (stable under line drift).
+The baseline only ever shrinks — tests/test_lint.py pins the shipped
+entry set, so a grown baseline fails CI with the new rule_ids listed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from mine_tpu.analysis import (  # noqa: E402 - stdlib-weight imports
+    REGISTRY,
+    apply_baseline,
+    load_baseline,
+    run,
+    scan_repo,
+)
+from mine_tpu.utils import verdict as verdict_util  # noqa: E402
+
+DEFAULT_BASELINE = "mine_tpu/analysis/baseline.jsonl"
+
+
+ALL_LINES = None  # sentinel: every line of the file counts as touched
+
+
+def changed_lines(rev: str, cwd: Path = REPO) -> dict[str, set[int] | None]:
+    """file -> 1-indexed lines added/modified vs `rev` (unified=0 hunks
+    of the new side), or ALL_LINES for untracked files — a brand-new
+    module is 100% "touched", and git diff does not show it. Files
+    outside both sets simply don't appear. A pure-deletion hunk (+c,0)
+    touches no surviving line."""
+    out = subprocess.run(
+        ["git", "diff", "--unified=0", rev, "--"],
+        capture_output=True, text=True, cwd=cwd, check=True,
+    ).stdout
+    lines: dict[str, set[int] | None] = {}
+    current: str | None = None
+    for raw in out.splitlines():
+        if raw.startswith("+++ b/"):
+            current = raw[6:]
+        elif raw.startswith("+++ "):
+            current = None  # /dev/null: deletion
+        elif raw.startswith("@@") and current is not None:
+            # @@ -a[,b] +c[,d] @@
+            plus = raw.split("+", 1)[1].split(" ", 1)[0]
+            start, _, count = plus.partition(",")
+            n = int(count) if count else 1
+            if n:
+                bucket = lines.setdefault(current, set())
+                if bucket is not None:
+                    bucket.update(range(int(start), int(start) + n))
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        capture_output=True, text=True, cwd=cwd, check=True,
+    ).stdout
+    for path in untracked.splitlines():
+        if path:
+            lines[path] = ALL_LINES
+    return lines
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--paths", default="mine_tpu,tools,bench.py",
+                    help="comma-separated scan roots (repo-relative)")
+    ap.add_argument("--baseline", default=str(REPO / DEFAULT_BASELINE),
+                    help="waiver baseline jsonl; empty string disables")
+    ap.add_argument("--changed", metavar="REV", default=None,
+                    help="report only findings on lines git-diff touched "
+                         "since REV (fast pre-commit path; stale waivers "
+                         "are not enforced in this mode)")
+    ap.add_argument("--json-out", default=None,
+                    help="additionally write the full verdict (all "
+                         "findings, waived included) to this file")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for checker in REGISTRY:
+            print(f"{checker.rule_id}: {checker.catches}")
+        return 0
+
+    repo = scan_repo(REPO, paths=[p for p in args.paths.split(",") if p])
+    findings = run(repo, REGISTRY)
+
+    if args.changed is not None:
+        touched = changed_lines(args.changed)
+        findings = [
+            f for f in findings
+            if f.file in touched
+            and (touched[f.file] is ALL_LINES or f.line in touched[f.file])
+        ]
+
+    try:
+        waivers = load_baseline(Path(args.baseline)) if args.baseline else []
+    except ValueError as exc:
+        return verdict_util.emit({
+            "metric": "static_analysis", "value": None, "ok": False,
+            "error": f"bad baseline: {exc}",
+        })
+    unwaived, waived, stale = apply_baseline(findings, waivers)
+
+    for f in unwaived:
+        print(f.render() + f"  [symbol: {f.symbol}]", file=sys.stderr)
+    # stale waivers keep the baseline honest: once the finding a waiver
+    # covered is fixed, the waiver line must be deleted (shrink-only).
+    # --changed sees a findings subset, so staleness is meaningless there
+    # — neither enforced nor reported.
+    enforce_stale = args.changed is None
+    if enforce_stale:
+        for w in stale:
+            print(f"stale waiver: {w.rule_id}:{w.file} "
+                  f"[symbol: {w.symbol}] — no finding matches; delete the "
+                  "baseline line", file=sys.stderr)
+
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    ok = not unwaived and not (enforce_stale and stale)
+    result = {
+        "metric": "static_analysis",
+        "value": 1.0 if ok else None,
+        "ok": ok,
+        "files_scanned": len(repo.modules),
+        "rules": len(REGISTRY),
+        "findings": len(findings),
+        "unwaived": len(unwaived),
+        "waived": len(waived),
+        "stale_waivers": len(stale) if enforce_stale else 0,
+        "by_rule": dict(sorted(by_rule.items())),
+        "changed_only": args.changed is not None,
+        "unwaived_findings": [f.render() for f in unwaived[:50]],
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump({**result, "all_findings":
+                       [f.to_json() for f in findings],
+                       "stale": [w.key for w in stale]}, fh, indent=2)
+    return verdict_util.emit(result)
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except BaseException as exc:  # noqa: BLE001 - emit-then-exit contract
+        from mine_tpu.utils.verdict import emit_failure
+
+        raise SystemExit(emit_failure("static_analysis", exc))

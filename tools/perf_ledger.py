@@ -35,6 +35,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from mine_tpu.obs import ledger  # noqa: E402 - stdlib-only import
+from mine_tpu.utils.verdict import emit  # noqa: E402 - the one-line contract
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -72,19 +73,17 @@ def main(argv: list[str] | None = None) -> int:
         # append to would silently pass on an empty ledger
         args.ledger = ledger.ledger_path()
         if args.ledger is None:
-            print(json.dumps({
+            return emit({
                 "ok": True, "note": "perf ledger disabled via "
                 f"${ledger.LEDGER_ENV} — nothing to {args.cmd}",
-            }))
-            return 0
+            })
 
     if args.cmd == "check":
         verdict = ledger.check(
             args.ledger, threshold=args.threshold, window=args.window,
             min_history=args.min_history,
         )
-        print(json.dumps(verdict))
-        return 0 if verdict["ok"] else 1
+        return emit(verdict)
 
     if args.cmd == "show":
         rows, bad = ledger.read(args.ledger)
