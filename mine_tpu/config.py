@@ -283,6 +283,40 @@ class ServingConfig:
     slo_availability_target: float = 0.995
     slo_p95_ms: float = 2000.0
     slo_window_s: float = 300.0
+    # --- elastic fleet (serving/autoscale.py) ---------------------------
+    # The controller scrapes the router's /metrics each interval and turns
+    # SLO burn rate + router p95 into live membership changes: joins
+    # pre-warm their future arc over the peer-fetch wire BEFORE ring
+    # admission, drains shed + hand their arc off before leaving.
+    # Membership bounds: the controller never drains below min or joins
+    # above max, whatever the signals say.
+    autoscale_min_replicas: int = 2
+    autoscale_max_replicas: int = 6
+    # controller tick cadence (one scrape + one decision per interval)
+    autoscale_interval_s: float = 10.0
+    # hysteresis: scale up after `up_after` CONSECUTIVE ticks with any SLO
+    # burn rate >= up_burn_threshold (1.0 = burning budget exactly at the
+    # objective's rate); scale down after `down_after` consecutive ticks
+    # with every burn rate <= down_burn_threshold. The down path is slower
+    # and stricter by default — flapping costs a pre-warm each way.
+    autoscale_up_burn_threshold: float = 1.0
+    autoscale_down_burn_threshold: float = 0.25
+    autoscale_up_after: int = 2
+    autoscale_down_after: int = 5
+    # no new scale event (either direction) within cooldown_s of the last
+    # one — the window in which the previous event's effect reaches the
+    # rolling SLO windows
+    autoscale_cooldown_s: float = 60.0
+    # how many hottest cache entries a join pre-warms / a drain hands off
+    # (MPICache.hot_keys order: most-recently-used first)
+    autoscale_prewarm_keys: int = 64
+    # budget for one join's spawn+pre-warm; expiry retires the joiner
+    # without ring admission (membership unchanged)
+    autoscale_join_timeout_s: float = 30.0
+    # budget for one drain's handoff; expiry abandons the handoff but the
+    # drain still completes — survivors fall back to peer-fetch while the
+    # victim is alive, then re-predict
+    autoscale_drain_timeout_s: float = 30.0
 
 
 @dataclass(frozen=True)

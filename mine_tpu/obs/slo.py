@@ -44,6 +44,7 @@ pass/fail block in their JSON.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from collections import deque
@@ -311,3 +312,87 @@ def tracker_from_config(
         window_s=s.slo_window_s,
         family_prefix=family_prefix,
     ))
+
+
+# -- exposition scraping (the autoscale controller's input) ----------------
+#
+# The controller (serving/autoscale.py) reads the router's /metrics TEXT
+# page over HTTP — the same surface Prometheus scrapes, deliberately not an
+# in-process shortcut, so a controller pointed at a remote router sees
+# exactly what these helpers parse. Scraping /metrics also refreshes the
+# mine_slo_* gauges (the handler evaluates the tracker first), so the burn
+# rates on the page are current as of the scrape.
+
+_EXPO_LABELS_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def _exposition_children(text: str, family: str) -> list[tuple[dict, float]]:
+    """[(labels, value)] for one family's sample lines on a text page."""
+    out: list[tuple[dict, float]] = []
+    for line in text.splitlines():
+        if not line.startswith(family):
+            continue
+        rest = line[len(family):]
+        if not rest or rest[0] not in " {":
+            continue  # a longer family name sharing the prefix
+        labels: dict[str, str] = {}
+        if rest[0] == "{":
+            body, _, rest = rest[1:].partition("}")
+            labels = dict(_EXPO_LABELS_RE.findall(body))
+        try:
+            value = float(rest.strip().split()[0])
+        except (ValueError, IndexError):
+            continue  # HELP/TYPE or malformed line: not a sample
+        out.append((labels, value))
+    return out
+
+
+def burn_rates_from_exposition(text: str) -> dict[str, float]:
+    """{slo name: burn rate} from a /metrics page's mine_slo_burn_rate
+    gauges — empty when the page carries none (a router that has never
+    been scraped has no SLO gauges yet; the controller holds)."""
+    return {
+        labels.get("slo", ""): value
+        for labels, value in _exposition_children(text, "mine_slo_burn_rate")
+        if labels.get("slo")
+    }
+
+
+def p95_from_exposition(
+    text: str,
+    family: str = "mine_fleet_request_latency_seconds",
+    endpoints: tuple[str, ...] = DEFAULT_ENDPOINTS,
+    q: float = 0.95,
+) -> float | None:
+    """The q-quantile (seconds) of a cumulative-bucket histogram family on
+    a /metrics text page, summed over its `endpoints` children — the same
+    linear in-bucket interpolation Histogram.quantile applies in-process.
+    None when the family has no observations (no signal, not 0 latency).
+    An observation landing in the +Inf bucket reports the last finite
+    edge — a floor, honest about being unbounded above."""
+    per_le: dict[float, float] = {}
+    for labels, value in _exposition_children(text, f"{family}_bucket"):
+        if endpoints and labels.get("endpoint") not in endpoints:
+            continue
+        le = labels.get("le", "")
+        edge = float("inf") if le == "+Inf" else float(le)
+        per_le[edge] = per_le.get(edge, 0.0) + value
+    if not per_le:
+        return None
+    edges = sorted(per_le)
+    total = per_le[edges[-1]]  # the +Inf (or last) cumulative count
+    if total <= 0:
+        return None
+    target = q * total
+    prev_edge, prev_cum = 0.0, 0.0
+    for edge in edges:
+        cum = per_le[edge]
+        if cum >= target:
+            if edge == float("inf"):
+                return prev_edge
+            if cum == prev_cum:
+                return edge
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_edge + frac * (edge - prev_edge)
+        prev_edge, prev_cum = edge, cum
+    return prev_edge

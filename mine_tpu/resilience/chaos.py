@@ -67,6 +67,19 @@ Kinds and their seams:
                        for a coordinator that is not up yet when workers
                        dial in — proves the retrying bring-up's backoff
                        path deterministically.
+  join_stall@scale=N   serving/autoscale.py raises during the Nth JOIN's
+                       pre-warm (after the replica spawned, BEFORE ring
+                       admission): the stand-in for a joiner that wedges
+                       while bulk-fetching its future arc — proves a
+                       stalled join never enters the ring (the joiner is
+                       retired, membership unchanged, no 5xx).
+  drain_timeout@scale=N  serving/autoscale.py raises during the Nth
+                       DRAIN's hot-entry handoff (the victim is already
+                       shedding): the stand-in for a handoff that expires
+                       its budget — proves the drain still completes
+                       (victim leaves the ring and exits) with the
+                       surviving owners falling back to the peer-fetch
+                       wire, never 5xx.
 
 Two trigger styles share one `should()` call: value-keyed kinds (counter
 `step`) fire when the caller's `at=` equals the trigger; invocation-keyed
@@ -99,6 +112,8 @@ KINDS: dict[str, str] = {
     "host_kill": "step",
     "host_stall": "step",
     "coord_down": "init",
+    "join_stall": "scale",
+    "drain_timeout": "scale",
 }
 _VALUE_KEYED = frozenset(k for k, c in KINDS.items() if c == "step")
 

@@ -129,6 +129,23 @@ class MPICache:
         with self._lock:
             return list(self._entries)
 
+    def hot_keys(self, n: int) -> list[tuple[str, int]]:
+        """The up-to-n most-recently-used entries as (wire key, compressed
+        nbytes), hottest first — exactly the REVERSE of eviction order, so
+        a pre-warm that fetches this list front-to-back moves the entries
+        eviction would take last. One surface serves both the autoscale
+        bulk fetch and the operator debug endpoint (serving/autoscale.py,
+        GET /debug/hot_keys)."""
+        if n <= 0:
+            return []
+        out: list[tuple[str, int]] = []
+        with self._lock:
+            for key in reversed(self._entries):
+                out.append((key_to_str(key), int(self._entries[key].nbytes)))
+                if len(out) >= n:
+                    break
+        return out
+
     def get(self, key: CacheKey, record: bool = True) -> Any | None:
         """Lookup + LRU touch. record=False skips the hit/miss counters —
         for internal re-checks (the predict singleflight's under-lock peek)

@@ -93,13 +93,22 @@ def _point(name: str) -> int:
     )
 
 
+# THE vnode count. The router's ring and every replica's peer ring
+# (server.py configure_peers) must agree on it, or the two sides order
+# failover/peer-fetch candidates differently and a "fetch from the owner"
+# silently asks a non-owner. One spelling, imported everywhere — the
+# router, configure_peers' default, and both CLIs (serving/server.py,
+# serving/autoscale.py, tools/bench_fleet.py).
+DEFAULT_VNODES = 64
+
+
 class HashRing:
     """Consistent-hash ring with virtual nodes (replicated hash points per
     member smooth the arc distribution, the classic Karger construction).
     Immutable once built — membership changes build a new ring, so readers
     never see a half-updated point list."""
 
-    def __init__(self, members: list[str], vnodes: int = 64):
+    def __init__(self, members: list[str], vnodes: int = DEFAULT_VNODES):
         self.members = sorted(set(members))
         self.vnodes = vnodes
         points: list[tuple[int, str]] = []
@@ -246,6 +255,26 @@ class FleetMetrics:
             "mine_fleet_probes_total",
             "health probes by replica and outcome (ok|fail)",
         )
+        self.ring_changes = r.counter(
+            "mine_fleet_ring_changes_total",
+            "explicit membership changes by op (join|leave) — autoscale/"
+            "admin admissions and retirements, distinct from the health "
+            "gate's hysteresis flips (ring_transitions)",
+        )
+        self.autoscale_decisions = r.counter(
+            "mine_fleet_autoscale_decisions_total",
+            "controller tick decisions by action "
+            "(hold|scale_up|scale_down|cooldown|at_min|at_max)",
+        )
+        self.autoscale_events = r.counter(
+            "mine_fleet_autoscale_events_total",
+            "completed scale events by direction (join|drain) and outcome "
+            "(ok|aborted|handoff_aborted)",
+        )
+        self.autoscale_target = r.gauge(
+            "mine_fleet_autoscale_target_replicas",
+            "the autoscale controller's current desired replica count",
+        )
 
     def render(self) -> str:
         return self.registry.render()
@@ -265,7 +294,7 @@ class FleetApp:
         max_attempts: int = 3,
         deadline_s: float = 30.0,
         retry_after_s: float = 1.0,
-        vnodes: int = 64,
+        vnodes: int = DEFAULT_VNODES,
         metrics: FleetMetrics | None = None,
         transport: Callable | None = None,
         clock: Callable[[], float] = time.monotonic,
@@ -292,6 +321,8 @@ class FleetApp:
             clock=clock,
         )
         set_build_info(self.metrics.registry, backend=None)
+        self.up_after = up_after
+        self.down_after = down_after
         self.replicas = {
             name: Replica(name, url, up_after, down_after)
             for name, url in replicas.items()
@@ -319,19 +350,59 @@ class FleetApp:
         with self._lock:
             return list(self._ring.members)
 
+    def add_replica(self, name: str, base_url: str) -> Replica:
+        """Admit a NEW replica into the live membership (an autoscale
+        join). The caller is responsible for having the replica
+        request-ready first — pre-warmed cache, warm pools — because the
+        moment this returns, its arc's traffic routes to it. Membership
+        mutates by whole-dict replacement so concurrent iterators
+        (probe_once, swap_all, health) only ever see a complete
+        membership, never a half-built one."""
+        with self._lock:
+            if name in self.replicas:
+                raise ValueError(f"replica {name!r} is already in the fleet")
+            replica = Replica(name, base_url, self.up_after, self.down_after)
+            self.replicas = {**self.replicas, name: replica}
+            self._rebuild_ring_locked()
+            self.metrics.ring_changes.inc(op="join")
+        return replica
+
+    def remove_replica(self, name: str) -> None:
+        """Retire a replica from the live membership (an autoscale drain's
+        last step). Its arc remaps to the ring neighbors — ONE arc, the
+        consistent-hash contract. Refuses to empty the fleet: a routerful
+        of nothing answers 503 forever with no path back."""
+        with self._lock:
+            if name not in self.replicas:
+                raise ValueError(f"replica {name!r} is not in the fleet")
+            remaining = {k: v for k, v in self.replicas.items() if k != name}
+            if not remaining:
+                raise ValueError(
+                    "refusing to remove the last replica — an empty fleet "
+                    "cannot recover"
+                )
+            self.replicas = remaining
+            self._rebuild_ring_locked()
+            self.metrics.replica_up.set(0, replica=name)
+            self.metrics.ring_changes.inc(op="leave")
+
+    def _rebuild_ring_locked(self) -> None:
+        """Rebuild the ring from the healthy members. Caller holds _lock."""
+        members = [r.name for r in self.replicas.values() if r.gate.healthy]
+        self._ring = HashRing(members, vnodes=self.vnodes)
+        for r in self.replicas.values():
+            self.metrics.replica_up.set(
+                1 if r.gate.healthy else 0, replica=r.name
+            )
+        self.metrics.ring_size.set(len(members))
+
     def _observe(self, replica: Replica, ok: bool) -> None:
         """Feed one health observation (probe or request-path); rebuild the
         ring on a hysteresis flip."""
         with self._lock:
             flipped = replica.gate.observe(ok)
             if flipped:
-                members = [r.name for r in self.replicas.values()
-                           if r.gate.healthy]
-                self._ring = HashRing(members, vnodes=self.vnodes)
-                self.metrics.replica_up.set(
-                    1 if replica.gate.healthy else 0, replica=replica.name
-                )
-                self.metrics.ring_size.set(len(members))
+                self._rebuild_ring_locked()
                 self.metrics.ring_transitions.inc(
                     replica=replica.name,
                     to="up" if replica.gate.healthy else "down",
@@ -384,7 +455,10 @@ class FleetApp:
     def candidates_for(self, digest: str) -> list[Replica]:
         with self._lock:
             names = self._ring.candidates(digest)
-        return [self.replicas[n] for n in names]
+            replicas = self.replicas
+        # membership may have changed between a racing reader's ring
+        # snapshot and here; a just-removed name is simply not a candidate
+        return [replicas[n] for n in names if n in replicas]
 
     def forward(
         self,
@@ -940,6 +1014,10 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--probe-interval", type=float, default=2.0)
     parser.add_argument("--max-attempts", type=int, default=3)
     parser.add_argument("--deadline", type=float, default=30.0)
+    parser.add_argument("--vnodes", type=int, default=DEFAULT_VNODES,
+                        help="virtual nodes per ring member; every "
+                        "replica's configure_peers MUST use the same "
+                        "value (DEFAULT_VNODES)")
     parser.add_argument("--verbose", action="store_true")
     args = parser.parse_args(argv)
     if not args.replica:
@@ -947,6 +1025,7 @@ def main(argv: list[str] | None = None) -> None:
     app = FleetApp(
         list(args.replica), probe_interval_s=args.probe_interval,
         max_attempts=args.max_attempts, deadline_s=args.deadline,
+        vnodes=args.vnodes,
     ).start()
     server = make_fleet_server(app, args.host, args.port,
                                verbose=args.verbose)

@@ -81,6 +81,12 @@ class ServingMetrics:
             "requests rejected before any work, by reason "
             "(queue_full|breaker_open|draining)",
         )
+        self.draining = r.gauge(
+            "mine_serve_draining",
+            "1 while this replica is in the drain shedding state "
+            "(/admin/drain — product POSTs answer 503 + Retry-After, the "
+            "peer-fetch wire stays served for the arc handoff), else 0",
+        )
         self.request_timeouts = r.counter(
             "mine_serve_request_timeouts_total",
             "requests that hit their deadline, by stage (queue = expired "
@@ -197,6 +203,16 @@ class ServingMetrics:
             "404; incompatible = the peer runs a different pruning "
             "operating point, config drift surfaced; timeout/error = "
             "degraded to a local re-predict)",
+        )
+
+        # autoscale pre-warm / handoff (serving/server.py prewarm): bulk
+        # adoption of hot entries over the same wire, driven by the
+        # controller before a join enters the ring / while a drain leaves
+        self.prewarm_keys = r.counter(
+            "mine_serve_prewarm_keys_total",
+            "pre-warm/handoff key outcomes (fetched = adopted over the "
+            "wire; resident = already cached here; miss = no source had "
+            "it; error = fetch/adopt failed, skipped)",
         )
 
         # MPI cache

@@ -94,6 +94,7 @@ from mine_tpu.serving.batcher import (
 )
 from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
 from mine_tpu.serving.compress import CompressedMPI, from_wire, to_wire
+from mine_tpu.serving.fleet import DEFAULT_VNODES
 from mine_tpu.serving.engine import (
     BucketSpec,
     RenderEngine,
@@ -283,6 +284,14 @@ class ServingApp:
         self.peer_name = None
         self._peer_ring = None
         self.configure_peers(peers, peer_name)
+        # drain shedding state (autoscale retirement, serving/autoscale.py):
+        # while True, product POSTs answer 503 + Retry-After (the router's
+        # cooldown steers traffic off this replica) but GET /mpi/<key> and
+        # the admin/debug surfaces stay served — the arc handoff and the
+        # survivors' peer fetch need exactly those. A plain bool, flipped
+        # atomically by set_draining; readers tolerate either value.
+        self.draining = False
+        self.metrics.draining.set(0)
         self.cache = MPICache(cache_bytes, metrics=self.metrics)
         self.batcher = MicroBatcher(
             self._guarded_render, max_delay_ms=max_delay_ms,
@@ -589,16 +598,18 @@ class ServingApp:
         return response(entry, cached=from_peer)
 
     def configure_peers(self, peers: dict[str, str] | None,
-                        peer_name: str | None, vnodes: int = 64) -> None:
+                        peer_name: str | None,
+                        vnodes: int = DEFAULT_VNODES) -> None:
         """(Re)declare fleet membership for peer fetch. Callable after
         construction because a replica's own URL typically exists only once
         its server has bound a port (tools/bench_fleet.py builds the apps
         first, then the servers). None/empty disables peer fetch.
 
-        `vnodes` MUST match the router's (FleetApp default 64): the
-        replica-side ring exists to agree with the router about who owns a
-        digest — a mismatched vnode count silently reorders candidates and
-        peer fetch asks the wrong peers (pure waste, never an error)."""
+        `vnodes` MUST match the router's — which is why the default IS the
+        router's (fleet.DEFAULT_VNODES, one spelling): the replica-side
+        ring exists to agree with the router about who owns a digest — a
+        mismatched vnode count silently reorders candidates and peer fetch
+        asks the wrong peers (pure waste, never an error)."""
         if not peers:
             self.peers, self.peer_name, self._peer_ring = {}, None, None
             return
@@ -721,6 +732,81 @@ class ServingApp:
             self.metrics.peer_fetch.inc(outcome=outcome)
         return None
 
+    def set_draining(self, draining: bool) -> None:
+        """Flip the drain shedding state (POST /admin/drain). Reversible:
+        an aborted drain flips back to serving with its cache intact."""
+        self.draining = bool(draining)
+        self.metrics.draining.set(1 if self.draining else 0)
+
+    def prewarm(self, keys: list[str], sources: list[str],
+                timeout_s: float | None = None,
+                request_id: str | None = None) -> dict[str, int]:
+        """Bulk-adopt cached MPIs over the fleet wire (GET /mpi/<key>)
+        BEFORE this replica serves their traffic — the autoscale join's
+        pre-warm and the drain handoff's receiving side. `keys` are wire
+        mpi_keys, hottest first (MPICache.hot_keys order, so an expired
+        budget kept the hottest); `sources` are base URLs of the current
+        owners, tried in order per key. Each attempt is bounded by the
+        peer-fetch budget; `timeout_s` additionally bounds the WHOLE pass.
+        Never raises: a short pre-warm is a warmer-than-nothing cache, and
+        anything it missed degrades to the ring's peer-fetch path. Returns
+        outcome counts (also ticked on mine_serve_prewarm_keys_total)."""
+        from mine_tpu.serving.fleet import _urllib_transport
+
+        counts = {"fetched": 0, "resident": 0, "miss": 0, "error": 0}
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s and timeout_s > 0 else None)
+        for key_str in keys:
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            try:
+                key = key_from_str(key_str)
+            except ValueError:
+                counts["error"] += 1
+                self.metrics.prewarm_keys.inc(outcome="error")
+                continue
+            if self.cache.get(key, record=False) is not None:
+                counts["resident"] += 1
+                self.metrics.prewarm_keys.inc(outcome="resident")
+                continue
+            outcome = "miss"
+            for base_url in sources:
+                budget = self.peer_fetch_timeout_s
+                if deadline is not None:
+                    budget = min(budget, deadline - time.monotonic())
+                if budget <= 0:
+                    break
+                url = f"{base_url.rstrip('/')}/mpi/{key_str}"
+                try:
+                    with self.tracer.span("prewarm_fetch", cat="serve",
+                                          request_id=request_id,
+                                          key=key_str[:16]):
+                        status, _, body = _urllib_transport(
+                            "GET", url, None, {}, budget
+                        )
+                    if status != 200:
+                        continue
+                    entry = from_wire(body)
+                    if tuple(entry.bucket) != tuple(key[2:5]):
+                        raise ValueError(
+                            f"source returned bucket {entry.bucket} for "
+                            f"key bucket {key[2:5]}"
+                        )
+                    entry = self.engine._adopt_entry(
+                        entry, request_id=request_id
+                    )
+                    self.cache.put(key, entry)
+                    outcome = "fetched"
+                    break
+                except TimeoutError:
+                    continue
+                except Exception:  # noqa: BLE001 - degrade, never raise
+                    outcome = "error"
+                    continue
+            counts[outcome] += 1
+            self.metrics.prewarm_keys.inc(outcome=outcome)
+        return counts
+
     def compressed_blob(self, key_str: str) -> bytes | None:
         """The cached entry for `key_str` as wire bytes (the GET /mpi/<key>
         body), or None when not resident. record=False: a peer's probe is
@@ -794,8 +880,14 @@ class ServingApp:
         status = {"closed": "ok", "half_open": "recovering"}.get(
             breaker_state, "degraded"
         )
+        if self.draining:
+            # a draining replica is deliberately out of service for product
+            # traffic: report it so routers/probes stop offering it work
+            # (the peer-fetch wire stays served regardless)
+            status = "draining"
         return {
             "status": status,
+            "draining": self.draining,
             "uptime_s": round(time.time() - self._started_at, 1),
             "backend": jax.default_backend(),
             "checkpoint_step": self.engine.checkpoint_step,
@@ -911,10 +1003,12 @@ class _Handler(BaseHTTPRequestHandler):
         app = self.server.app
         if method == "GET" and path == "/healthz":
             health = app.health()
-            # degraded (breaker OPEN) answers 503 so load balancers drain
-            # this replica; "recovering" (half-open) answers 200 so the
-            # recovery trial can arrive; the body carries the full snapshot
-            code = 503 if health["status"] == "degraded" else 200
+            # degraded (breaker OPEN) and draining answer 503 so load
+            # balancers/probes drain this replica; "recovering" (half-open)
+            # answers 200 so the recovery trial can arrive; the body
+            # carries the full snapshot
+            code = (503 if health["status"] in ("degraded", "draining")
+                    else 200)
             self._send_json(code, health)
             return code, "healthz"
         if method == "GET" and path == "/metrics":
@@ -936,9 +1030,23 @@ class _Handler(BaseHTTPRequestHandler):
                     extra_events=app.memlog.counter_events()
                 ))
             return 200, "debug_trace"
-        if method == "POST" and path == "/predict":
-            return self._predict(app), "predict"
-        if method == "POST" and path == "/render":
+        if method == "POST" and path in ("/predict", "/render"):
+            if app.draining:
+                # drain shedding: product traffic bounces with the same
+                # 503 + Retry-After contract as overload — the router's
+                # cooldown steers the arc to its new owner while the
+                # peer-fetch wire below keeps serving the handoff
+                app.metrics.shed_requests.inc(reason="draining")
+                retry_after = max(app.retry_after_s, 0.1)
+                self._send_json(
+                    503,
+                    {"error": "replica draining",
+                     "retry_after_s": retry_after},
+                    {"Retry-After": f"{retry_after:.1f}"},
+                )
+                return 503, path.lstrip("/")
+            if path == "/predict":
+                return self._predict(app), "predict"
             return self._render(app), "render"
         if method == "GET" and path.startswith("/mpi/"):
             # the fleet wire: the compressed container for one cache key,
@@ -961,8 +1069,94 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, "admin_swap"
         if method == "POST" and path == "/admin/swap":
             return self._admin_swap(app), "admin_swap"
+        if method == "GET" and path == "/debug/hot_keys":
+            # the hot-key surface (MPICache.hot_keys): what a joining
+            # replica pre-warms and what an operator reads to see the arc
+            query = parse_qs(self.path.partition("?")[2])
+            try:
+                n = int((query.get("n") or ["64"])[0])
+            except ValueError:
+                self._send_json(400, {"error": "n must be an integer"})
+                return 400, "debug_hot_keys"
+            self._send_json(200, {"hot_keys": [
+                {"mpi_key": k, "nbytes": b}
+                for k, b in app.cache.hot_keys(n)
+            ]})
+            return 200, "debug_hot_keys"
+        if method == "POST" and path == "/admin/drain":
+            return self._admin_drain(app), "admin_drain"
+        if method == "POST" and path == "/admin/peers":
+            return self._admin_peers(app), "admin_peers"
+        if method == "POST" and path == "/admin/prewarm":
+            return self._admin_prewarm(app), "admin_prewarm"
         self._send_json(404, {"error": f"no route {method} {path}"})
         return 404, "unknown"
+
+    def _admin_drain(self, app: ServingApp) -> int:
+        """Flip the drain shedding state: {"draining": true|false}."""
+        try:
+            req = json.loads(self._read_body() or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("body must be a JSON object")
+            draining = bool(req.get("draining", True))
+        except (ValueError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad drain body: {exc}"})
+            return 400
+        app.set_draining(draining)
+        self._send_json(200, {"draining": app.draining})
+        return 200
+
+    def _admin_peers(self, app: ServingApp) -> int:
+        """(Re)declare fleet membership for peer fetch on a LIVE replica:
+        {"peers": {name: url}, "peer_name": str, "vnodes"?: int} — the
+        autoscale controller fans this out after every membership change so
+        each replica's peer ring keeps agreeing with the router's."""
+        try:
+            req = json.loads(self._read_body() or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("body must be a JSON object")
+            peers = req.get("peers") or None
+            if peers is not None and not (
+                isinstance(peers, dict)
+                and all(isinstance(k, str) and isinstance(v, str)
+                        for k, v in peers.items())
+            ):
+                raise ValueError("peers must map name -> base URL")
+            vnodes = int(req.get("vnodes", DEFAULT_VNODES))
+            app.configure_peers(peers, req.get("peer_name"), vnodes=vnodes)
+        except (ValueError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad peers body: {exc}"})
+            return 400
+        self._send_json(200, {
+            "peers": sorted(app.peers), "peer_name": app.peer_name,
+        })
+        return 200
+
+    def _admin_prewarm(self, app: ServingApp) -> int:
+        """Bulk pre-warm over the fleet wire: {"keys": [mpi_key...],
+        "sources": [base_url...], "timeout_s"?: float} -> outcome counts
+        (ServingApp.prewarm — never fails the pass; a short pre-warm
+        reports its counts honestly)."""
+        try:
+            req = json.loads(self._read_body() or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("body must be a JSON object")
+            keys = req.get("keys") or []
+            sources = req.get("sources") or []
+            if not all(isinstance(k, str) for k in keys) or not all(
+                isinstance(s, str) for s in sources
+            ):
+                raise ValueError("keys and sources must be string lists")
+            timeout_s = req.get("timeout_s")
+            if timeout_s is not None:
+                timeout_s = float(timeout_s)
+        except (ValueError, TypeError) as exc:
+            self._send_json(400, {"error": f"bad prewarm body: {exc}"})
+            return 400
+        counts = app.prewarm(list(keys), list(sources), timeout_s=timeout_s,
+                             request_id=self.request_id)
+        self._send_json(200, counts)
+        return 200
 
     def _handle(self, method: str) -> None:
         app = self.server.app
@@ -1018,7 +1212,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
             except Exception:  # noqa: BLE001 - client already gone
                 pass
-        if endpoint not in ("metrics", "healthz", "debug_trace"):
+        if endpoint not in ("metrics", "healthz", "debug_trace",
+                            "debug_hot_keys"):
             # the request-root span: carries this replica's span_id (what
             # a downstream peer fetch points at) and the upstream hop's
             # parent — scrape traffic stays out of the ring

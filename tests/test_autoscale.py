@@ -1,0 +1,503 @@
+"""Autoscale subsystem tests: cache hot-key/eviction-order agreement, the
+controller's hysteresis/cooldown/clamp decision machine (fake scrape text +
+injected clock — no sleeping), the exposition scrape helpers it reads, and
+the cache-aware join/drain protocols end-to-end over InProcessPool
+(FakeEngine replicas behind real ephemeral-port servers — zero XLA
+compiles), including the `join_stall`/`drain_timeout` chaos seams."""
+
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mine_tpu.obs.slo import burn_rates_from_exposition, p95_from_exposition
+from mine_tpu.resilience import chaos
+from mine_tpu.serving.autoscale import (
+    AutoscaleController,
+    InProcessPool,
+    routing_digest,
+)
+from mine_tpu.serving.cache import MPICache, key_to_str, mpi_key
+from mine_tpu.serving.fake import make_fake_app
+from mine_tpu.serving.fleet import DEFAULT_VNODES, FleetApp, make_fleet_server
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    chaos.uninstall()
+
+
+# ------------------------------------------------- hot keys vs eviction order
+
+
+class _Blob:
+    """Anything with .nbytes is cacheable (cache.py's value contract)."""
+
+    def __init__(self, nbytes: int):
+        self.nbytes = nbytes
+
+
+def _key(i: int):
+    return mpi_key(f"d{i}", 1, (8, 8, 2))
+
+
+def test_hot_keys_hottest_first_and_reverse_of_eviction():
+    """hot_keys(n) must list entries in EXACTLY the reverse of the order
+    eviction would take them — the autoscale pre-warm fetches it
+    front-to-back, so the entries eviction keeps longest move first."""
+    cache = MPICache(byte_budget=1 << 20)
+    for i in range(4):
+        cache.put(_key(i), _Blob(100))
+    cache.get(_key(1))  # LRU touch: 1 is now hottest
+    hot = cache.hot_keys(10)
+    assert [k for k, _ in hot] == [key_to_str(_key(i)) for i in (1, 3, 2, 0)]
+    assert all(n == 100 for _, n in hot)
+    # now force evictions one at a time and check they come coldest-first,
+    # i.e. hot_keys read back-to-front
+    evict_order = []
+    small = MPICache(byte_budget=400)
+    for i in range(4):
+        small.put(_key(i), _Blob(100))
+    small.get(_key(1))
+    expected = [k for k, _ in small.hot_keys(10)][::-1]
+    for j in range(4, 8):
+        for victim in small.put(_key(j), _Blob(100)):
+            evict_order.append(key_to_str(victim))
+    assert evict_order == expected
+
+
+def test_hot_keys_truncates_and_handles_empty():
+    cache = MPICache(byte_budget=1 << 20)
+    assert cache.hot_keys(5) == []
+    for i in range(3):
+        cache.put(_key(i), _Blob(10))
+    assert len(cache.hot_keys(2)) == 2
+    assert cache.hot_keys(0) == []
+
+
+def test_routing_digest_is_wire_key_first_field():
+    key = mpi_key("abcd1234", 7, (16, 16, 4), tier="q8")
+    assert routing_digest(key_to_str(key)) == "abcd1234"
+
+
+def test_default_vnodes_single_spelling():
+    """fleet.py's DEFAULT_VNODES is the one spelling; autoscale and the
+    serving app re-export/consume it rather than re-defining."""
+    from mine_tpu.serving import autoscale, server
+
+    assert autoscale.DEFAULT_VNODES is DEFAULT_VNODES
+    assert server.DEFAULT_VNODES is DEFAULT_VNODES
+
+
+# ------------------------------------------------- exposition scrape helpers
+
+
+EXPO = """\
+# HELP mine_slo_burn_rate in-window error rate over the error budget
+# TYPE mine_slo_burn_rate gauge
+mine_slo_burn_rate{slo="availability"} 2.5
+mine_slo_burn_rate{slo="latency_p95"} 0.125
+mine_slo_burn_rate_other{slo="decoy"} 9.0
+mine_fleet_request_latency_seconds_bucket{endpoint="render",le="0.1"} 50
+mine_fleet_request_latency_seconds_bucket{endpoint="render",le="1.0"} 100
+mine_fleet_request_latency_seconds_bucket{endpoint="render",le="+Inf"} 100
+mine_fleet_request_latency_seconds_bucket{endpoint="healthz",le="+Inf"} 999
+"""
+
+
+def test_burn_rates_from_exposition_labels_and_prefix_decoys():
+    burns = burn_rates_from_exposition(EXPO)
+    assert burns == {"availability": 2.5, "latency_p95": 0.125}
+
+
+def test_p95_from_exposition_interpolates_and_filters_endpoints():
+    # target = 0.95 * 100 = 95 of the render observations: inside the
+    # (0.1, 1.0] bucket at frac (95-50)/(100-50) = 0.9 -> 0.91s. The
+    # healthz child (not a product endpoint) must not drag it down.
+    p95 = p95_from_exposition(EXPO)
+    assert p95 == pytest.approx(0.91)
+    assert p95_from_exposition("") is None
+    # an observation landing in +Inf reports the last finite edge
+    inf_heavy = (
+        'mine_fleet_request_latency_seconds_bucket{endpoint="render",'
+        'le="0.5"} 1\n'
+        'mine_fleet_request_latency_seconds_bucket{endpoint="render",'
+        'le="+Inf"} 10\n'
+    )
+    assert p95_from_exposition(inf_heavy) == pytest.approx(0.5)
+
+
+# --------------------------------------------------- controller decisions
+
+
+class _NullPool:
+    """A pool whose spawn always fails — decision tests only ever observe
+    the action; a scale_up records join/aborted and changes nothing."""
+
+    def spawn(self):
+        raise RuntimeError("null pool")
+
+    def names(self):
+        return []
+
+    def retire(self, name):
+        pass
+
+    def close(self):
+        pass
+
+
+class FakeTransport:
+    def __init__(self, behaviors):
+        self.behaviors = behaviors
+
+    def __call__(self, method, url, body, headers, timeout_s):
+        for prefix, behavior in self.behaviors.items():
+            if url.startswith(prefix):
+                if isinstance(behavior, Exception):
+                    raise behavior
+                return behavior
+        raise AssertionError(f"unscripted url {url}")
+
+
+def _burn_text(burn: float) -> str:
+    return f'mine_slo_burn_rate{{slo="availability"}} {burn}\n'
+
+
+def _controller(n_replicas=2, scrape_burn=0.0, **kw):
+    """A controller over a probe-less FleetApp + null pool, with a list-
+    backed fake clock and a mutable scrape cell."""
+    transport = FakeTransport({"http://": (200, {}, b"{}")})
+    fleet = FleetApp(
+        {f"r{i}": f"http://r{i}" for i in range(n_replicas)},
+        transport=transport, probe_interval_s=3600,
+    )
+    clock_cell = [0.0]
+    scrape_cell = [_burn_text(scrape_burn)]
+
+    def scrape():
+        out = scrape_cell[0]
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_s", 0.0)
+    ctl = AutoscaleController(
+        fleet, _NullPool(), scrape, clock=lambda: clock_cell[0], **kw,
+    )
+    return ctl, clock_cell, scrape_cell
+
+
+def test_controller_rejects_bad_bounds():
+    transport = FakeTransport({"http://": (200, {}, b"{}")})
+    fleet = FleetApp({"r0": "http://r0"}, transport=transport,
+                     probe_interval_s=3600)
+    with pytest.raises(ValueError):
+        AutoscaleController(fleet, _NullPool(), min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscaleController(fleet, _NullPool(),
+                            min_replicas=3, max_replicas=2)
+
+
+def test_hysteresis_up_needs_consecutive_breaches():
+    ctl, _clock, scrape = _controller(scrape_burn=2.0, up_after=2)
+    assert ctl.tick()["action"] == "hold"  # breach tick 1 of 2
+    scrape[0] = _burn_text(0.0)
+    assert ctl.tick()["action"] == "hold"  # calm tick resets the streak
+    scrape[0] = _burn_text(2.0)
+    assert ctl.tick()["action"] == "hold"
+    rec = ctl.tick()
+    assert rec["action"] == "scale_up"
+    assert rec["ok"] is False  # null pool: join aborted, nothing changed
+    assert rec["replicas_after"] == 2
+    assert ctl.fleet.metrics.autoscale_events.value(
+        direction="join", outcome="aborted") == 1.0
+
+
+def test_hysteresis_down_is_slower_and_needs_all_calm():
+    ctl, _clock, scrape = _controller(scrape_burn=0.0,
+                                      up_after=1, down_after=3)
+    assert ctl.tick()["action"] == "hold"
+    assert ctl.tick()["action"] == "hold"
+    # a tick that is neither breached nor calm (burn between the two
+    # thresholds) resets the calm streak
+    scrape[0] = _burn_text(0.5)
+    assert ctl.tick()["action"] == "hold"
+    scrape[0] = _burn_text(0.0)
+    for _ in range(2):
+        assert ctl.tick()["action"] == "hold"
+    assert ctl.tick()["action"] == "scale_down"
+
+
+def test_clamps_at_max_and_at_min():
+    ctl, _clock, _scrape = _controller(
+        n_replicas=2, scrape_burn=9.0, up_after=1, max_replicas=2,
+    )
+    assert ctl.tick()["action"] == "at_max"
+    ctl2, _clock2, _scrape2 = _controller(
+        n_replicas=2, scrape_burn=0.0, down_after=1, min_replicas=2,
+    )
+    assert ctl2.tick()["action"] == "at_min"
+
+
+def test_cooldown_blocks_until_clock_advances():
+    ctl, clock, _scrape = _controller(
+        scrape_burn=9.0, up_after=1, cooldown_s=60.0,
+    )
+    ctl._mark_event()  # a just-finished scale event at t=0
+    assert ctl.tick()["action"] == "cooldown"
+    clock[0] = 59.0
+    assert ctl.tick()["action"] == "cooldown"
+    clock[0] = 61.0
+    assert ctl.tick()["action"] == "scale_up"  # persisted breach fires
+
+
+def test_p95_ceiling_is_an_up_signal():
+    ctl, _clock, scrape = _controller(
+        scrape_burn=0.0, up_after=1, p95_up_threshold_s=0.5,
+    )
+    scrape[0] = (
+        _burn_text(0.0)
+        + 'mine_fleet_request_latency_seconds_bucket{endpoint="render",'
+        'le="2.0"} 10\n'
+        + 'mine_fleet_request_latency_seconds_bucket{endpoint="render",'
+        'le="+Inf"} 10\n'
+    )
+    rec = ctl.tick()
+    assert rec["action"] == "scale_up"
+    assert rec["router_p95_s"] == pytest.approx(1.9)  # 0.95 into (0, 2.0]
+
+
+def test_scrape_failure_is_a_hold_not_a_crash():
+    ctl, _clock, scrape = _controller(scrape_burn=9.0, up_after=1)
+    scrape[0] = ConnectionError("router down")
+    rec = ctl.tick()
+    assert rec == {"action": "hold", "reason": "scrape_failed"}
+    assert ctl.fleet.metrics.autoscale_decisions.value(action="hold") == 1.0
+
+
+def test_empty_burns_count_as_calm():
+    """A page with no SLO gauges yet (never-scraped router) must not hold
+    the fleet above min forever — but also must not scale up."""
+    ctl, _clock, scrape = _controller(
+        n_replicas=2, scrape_burn=0.0, down_after=1, min_replicas=2,
+    )
+    scrape[0] = ""  # no burn gauges at all
+    assert ctl.tick()["action"] == "at_min"
+
+
+def test_status_reports_signals():
+    ctl, _clock, _scrape = _controller(scrape_burn=0.5, up_after=5)
+    ctl.tick()
+    st = ctl.status()
+    assert st["replicas"] == 2
+    assert st["burn_rates"] == {"availability": 0.5}
+    assert st["breach_ticks"] == 0 and st["calm_ticks"] == 0
+
+
+def test_controller_from_config_reads_the_one_spelling():
+    from mine_tpu.config import Config
+    from mine_tpu.serving.autoscale import controller_from_config
+
+    cfg = Config()
+    transport = FakeTransport({"http://": (200, {}, b"{}")})
+    fleet = FleetApp({"r0": "http://r0", "r1": "http://r1"},
+                     transport=transport, probe_interval_s=3600)
+    ctl = controller_from_config(fleet, _NullPool(), cfg)
+    s = cfg.serving
+    assert ctl.min_replicas == s.autoscale_min_replicas
+    assert ctl.max_replicas == s.autoscale_max_replicas
+    assert ctl.interval_s == s.autoscale_interval_s
+    assert ctl.up_burn_threshold == s.autoscale_up_burn_threshold
+    assert ctl.down_burn_threshold == s.autoscale_down_burn_threshold
+    assert ctl.up_after == s.autoscale_up_after
+    assert ctl.down_after == s.autoscale_down_after
+    assert ctl.cooldown_s == s.autoscale_cooldown_s
+    assert ctl.prewarm_keys == s.autoscale_prewarm_keys
+    assert ctl.join_timeout_s == s.autoscale_join_timeout_s
+    assert ctl.drain_timeout_s == s.autoscale_drain_timeout_s
+    assert ctl.p95_up_threshold_s == pytest.approx(s.slo_p95_ms / 1000.0)
+
+
+# -------------------------------------------------- fleet membership changes
+
+
+def test_fleet_add_remove_replica_and_ring_counters():
+    transport = FakeTransport({"http://": (200, {}, b"{}")})
+    fleet = FleetApp({"r0": "http://r0", "r1": "http://r1"},
+                     transport=transport, probe_interval_s=3600)
+    assert sorted(fleet.ring_members()) == ["r0", "r1"]
+    fleet.add_replica("r2", "http://r2")
+    assert sorted(fleet.ring_members()) == ["r0", "r1", "r2"]
+    assert fleet.metrics.ring_changes.value(op="join") == 1.0
+    with pytest.raises(ValueError):
+        fleet.add_replica("r2", "http://elsewhere")  # duplicate name
+    fleet.remove_replica("r2")
+    assert sorted(fleet.ring_members()) == ["r0", "r1"]
+    assert fleet.metrics.ring_changes.value(op="leave") == 1.0
+    with pytest.raises(ValueError):
+        fleet.remove_replica("nope")
+    fleet.remove_replica("r1")
+    with pytest.raises(ValueError):
+        fleet.remove_replica("r0")  # a fleet never goes empty
+
+
+# ------------------------------------------- join/drain end-to-end (in-proc)
+
+
+def _png(i: int) -> bytes:
+    from PIL import Image
+
+    img = np.full((8, 8, 3), (i * 53) % 256, np.uint8)
+    img[0, 0] = (i % 256, 3, 9)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class _ElasticFleet:
+    """2 FakeEngine replicas behind a real router server, plus a
+    controller with saturated hysteresis (scale only via scale_to)."""
+
+    def __init__(self):
+        self.pool = InProcessPool(
+            app_factory=lambda: make_fake_app(checkpoint_step=1))
+        for _ in range(2):
+            self.pool.spawn()
+        urls = self.pool.urls()
+        self.pool.configure_peers(urls)
+        self.fleet = FleetApp(urls, probe_interval_s=3600,
+                              max_attempts=3, deadline_s=15.0)
+        self.srv = make_fleet_server(self.fleet)
+        h, p = self.srv.server_address[:2]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+        self.base = f"http://{h}:{p}"
+        self.controller = AutoscaleController(
+            self.fleet, self.pool, scrape=f"{self.base}/metrics",
+            min_replicas=2, max_replicas=4,
+            up_after=10**6, down_after=10**6, cooldown_s=0.0,
+            join_timeout_s=15.0, drain_timeout_s=15.0,
+        )
+
+    def http(self, path, data=None, headers=None):
+        req = urllib.request.Request(self.base + path, data=data,
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=15.0) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as err:
+            return err.code, err.read()
+
+    def seed(self, n):
+        keys = []
+        for i in range(n):
+            code, body = self.http(
+                "/predict", data=_png(i),
+                headers={"Content-Type": "image/png"})
+            assert code == 200, body
+            keys.append(json.loads(body)["mpi_key"])
+        return keys
+
+    def render_all(self, keys):
+        codes = []
+        for i, key in enumerate(keys):
+            payload = json.dumps({
+                "mpi_key": key, "offsets": [[0.01, 0.0, 0.0]],
+            }).encode()
+            hdr = {"Content-Type": "application/json"}
+            code, _ = self.http("/render", data=payload, headers=hdr)
+            if code == 404:  # documented contract: re-predict, render again
+                pc, _ = self.http("/predict", data=_png(i),
+                                  headers={"Content-Type": "image/png"})
+                assert pc == 200
+                code, _ = self.http("/render", data=payload, headers=hdr)
+            codes.append(code)
+        return codes
+
+    def encoder_total(self):
+        total = 0.0
+        for name in self.pool.names():
+            total += self.pool.app(name).metrics.encoder_invocations.value()
+        return total
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+        self.fleet.close()
+        self.pool.close()
+
+
+@pytest.fixture
+def elastic():
+    ef = _ElasticFleet()
+    yield ef
+    ef.close()
+
+
+def test_join_and_drain_conserve_encoder_invocations(elastic):
+    """The cache-aware proof at tier-1 scale: fleet-wide
+    encoder_invocations stays == #images across a join AND a drain —
+    every moved arc was pre-warmed/handed off, never re-encoded."""
+    keys = elastic.seed(4)
+    assert elastic.encoder_total() == 4.0
+    assert elastic.controller.scale_to(3) == 3
+    assert len(elastic.fleet.ring_members()) == 3
+    assert all(c == 200 for c in elastic.render_all(keys))
+    assert elastic.encoder_total() == 4.0  # join moved arcs, re-encoded none
+    ev = elastic.fleet.metrics.autoscale_events
+    assert ev.value(direction="join", outcome="ok") == 1.0
+    assert elastic.controller.scale_to(2) == 2
+    assert len(elastic.fleet.ring_members()) == 2
+    assert all(c == 200 for c in elastic.render_all(keys))
+    assert elastic.encoder_total() == 4.0  # drain handed its arc off
+    assert ev.value(direction="drain", outcome="ok") == 1.0
+    # the pool never leaks a retired replica
+    assert len(elastic.pool.names()) == 2
+
+
+def test_scale_to_clamps_to_bounds(elastic):
+    assert elastic.controller.scale_to(99) == 4
+    assert elastic.controller.scale_to(0) == 2
+
+
+def test_join_stall_never_enters_ring(elastic):
+    elastic.seed(2)
+    schedule = chaos.install("join_stall@scale=1")
+    assert elastic.controller.scale_to(3) == 2
+    assert schedule.pending() == []  # the seam actually fired
+    assert len(elastic.fleet.ring_members()) == 2
+    assert len(elastic.pool.names()) == 2  # the spawned joiner was retired
+    ev = elastic.fleet.metrics.autoscale_events
+    assert ev.value(direction="join", outcome="aborted") == 1.0
+
+
+def test_drain_timeout_still_completes_without_5xx(elastic):
+    keys = elastic.seed(3)
+    assert elastic.controller.scale_to(3) == 3
+    schedule = chaos.install("drain_timeout@scale=1")
+    assert elastic.controller.scale_to(2) == 2
+    assert schedule.pending() == []
+    assert len(elastic.fleet.ring_members()) == 2
+    assert len(elastic.pool.names()) == 2
+    ev = elastic.fleet.metrics.autoscale_events
+    assert ev.value(direction="drain", outcome="handoff_aborted") == 1.0
+    # the cold arc costs warmth, never availability: everything still 200s
+    assert all(c == 200 for c in elastic.render_all(keys))
+
+
+def test_hot_keys_debug_endpoint(elastic):
+    elastic.seed(3)
+    name = elastic.pool.names()[0]
+    url = elastic.pool.urls()[name]
+    with urllib.request.urlopen(f"{url}/debug/hot_keys?n=2",
+                                timeout=10.0) as resp:
+        data = json.loads(resp.read())
+    app_hot = elastic.pool.app(name).cache.hot_keys(2)
+    assert [(d["mpi_key"], d["nbytes"]) for d in data["hot_keys"]] == app_hot

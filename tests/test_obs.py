@@ -319,13 +319,23 @@ def test_serving_metrics_histograms_and_debug_trace(tiny_serving_app):
     except urllib.error.HTTPError as err:
         assert err.code == 400
 
-    status, body = _get(base, "/metrics")
-    assert status == 200
-    text = body.decode()
+    # the handler observes request_latency AFTER writing the response, so
+    # a scrape racing the tail of the 400's handler thread can miss the
+    # sample — poll briefly instead of asserting on the first page
+    bucket_line = ('mine_serve_request_latency_seconds_bucket'
+                   '{endpoint="render",le="+Inf"} 1')
+    deadline = time.monotonic() + 5.0
+    while True:
+        status, body = _get(base, "/metrics")
+        assert status == 200
+        text = body.decode()
+        if bucket_line in text or time.monotonic() >= deadline:
+            break
+        time.sleep(0.05)
     assert "# TYPE mine_serve_request_latency_seconds histogram" in text
     assert "# TYPE mine_serve_queue_delay_seconds histogram" in text
     assert "# TYPE mine_serve_trace_spans_total counter" in text
-    assert 'mine_serve_request_latency_seconds_bucket{endpoint="render",le="+Inf"} 1' in text
+    assert bucket_line in text
     # MFU gauge family exists even before any render resolves it
     assert "# TYPE mine_serve_mfu gauge" in text
 

@@ -46,6 +46,12 @@ importable for the tier-1 smoke.
     # traffic: several (H, W, S) shapes in ONE skew trace, per-bucket AOT
     # warm pools, mid-flood hot swap, compile counter asserted FLAT
     # (run_mixed_bucket; dedicated fleet_mixed_bucket ledger stream)
+  python tools/bench_fleet.py --ramp                   # elastic ramp:
+    # load doubles mid-flood, the autoscale controller
+    # (serving/autoscale.py) scales 2 -> 4 -> 2 UNDER traffic with
+    # cache-aware pre-warm/handoff; zero 5xx and fleet-wide
+    # encoder_invocations == images asserted across BOTH transitions
+    # (run_ramp; dedicated fleet_scale ledger stream)
 """
 
 from __future__ import annotations
@@ -68,6 +74,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 METRIC = "fleet_renders_per_sec"
 ECON_METRIC = "fleet_cache_economics"
 MIXED_METRIC = "fleet_mixed_bucket"
+RAMP_METRIC = "fleet_scale"
 BENCH_PLANES = 8  # enough planes that pruning has something to prune
 
 # the default mixed-bucket shape set: three genuinely different (H, W, S)
@@ -694,6 +701,258 @@ def run_mixed_bucket(
             app.close()
 
 
+def run_ramp(
+    images: int = 12,
+    requests: int = 150,
+    concurrency: int = 4,
+    vnodes: int | None = None,
+    cache_mb: int = 2048,
+    prewarm_keys: int = 64,
+) -> dict:
+    """The elastic-fleet ramp: load doubles mid-flood and the autoscale
+    controller (serving/autoscale.py) moves membership 2 -> 4 -> 2 UNDER
+    traffic — each transition fired by a client thread mid-phase, exactly
+    like run_mixed_bucket's mid-flood swap.
+
+    Gates (raise on violation — bench.py discipline):
+      * ZERO non-200s across every phase: a join pre-warms its arc before
+        the router admits it, a drain sheds behind the router's failover
+        (clients never see the victim's 503s);
+      * cache-aware conservation: fleet-wide encoder_invocations == images
+        after the scale-up AND after the scale-down — every moved arc was
+        served by pre-warm/handoff over the compressed wire, never by a
+        re-encode;
+      * both joins and both drains complete (membership lands back at 2).
+
+    Per-phase JSON reports router p50/p95, the phase hit rate and its dip
+    vs the previous phase (the price of a moved arc), and one live
+    controller tick's decision record (burn rates + router p95 scraped
+    from the REAL fleet /metrics endpoint — the production signal path).
+    The CLI appends the headline to the dedicated `fleet_scale` ledger
+    stream (p95/hit-rate gated by `perf_ledger.py check`).
+    """
+    import numpy as np
+
+    from mine_tpu.obs.slo import SLOTracker, default_objectives
+    from mine_tpu.serving.autoscale import AutoscaleController, InProcessPool
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.fleet import DEFAULT_VNODES, FleetApp, \
+        make_fleet_server
+
+    if vnodes is None:
+        vnodes = DEFAULT_VNODES
+    pool = InProcessPool(app_factory=lambda: make_fake_app(
+        cfg=_bench_cfg("fp32", 0.0), cache_bytes=cache_mb << 20,
+    ))
+    fleet = None
+    fleet_srv = None
+    try:
+        for _ in range(2):
+            pool.spawn()
+        urls = pool.urls()
+        pool.configure_peers(urls, vnodes)
+        fleet = FleetApp(urls, probe_interval_s=1.0, vnodes=vnodes).start()
+        fleet_srv = make_fleet_server(fleet)
+        fh, fp = fleet_srv.server_address[:2]
+        threading.Thread(target=fleet_srv.serve_forever, daemon=True).start()
+        base = f"http://{fh}:{fp}"
+        # hysteresis saturated high: ticks only OBSERVE (live decision
+        # records over the real scrape path); the phase transitions are
+        # driven deterministically through the same join/drain protocols
+        # via scale_to
+        controller = AutoscaleController(
+            fleet, pool, scrape=f"{base}/metrics",
+            min_replicas=2, max_replicas=4, up_after=10**6,
+            down_after=10**6, cooldown_s=0.0, prewarm_keys=prewarm_keys,
+        )
+
+        pngs = _make_pngs(images)
+        keys: list[str] = []
+        for png in pngs:
+            code, body = _http(base, "/predict", data=png,
+                               headers={"Content-Type": "image/png"})
+            assert code == 200, body
+            keys.append(json.loads(body)["mpi_key"])
+
+        rng = np.random.default_rng(0)
+        weights = 1.0 / np.arange(1, images + 1)
+        weights /= weights.sum()
+
+        def counters() -> dict[str, dict[str, float]]:
+            out = {}
+            for name, url in pool.urls().items():
+                _, body = _http(url, "/metrics")
+                text = body.decode()
+                out[name] = {
+                    "enc": _metric_value(
+                        text, "mine_serve_encoder_invocations_total"),
+                    "hits": _metric_value(
+                        text, "mine_serve_cache_hits_total"),
+                    "misses": _metric_value(
+                        text, "mine_serve_cache_misses_total"),
+                }
+            return out
+
+        slo = SLOTracker(fleet.metrics.registry, default_objectives(
+            family_prefix="mine_fleet", p95_s=5.0,
+        ))
+        all_latencies: list[float] = []
+        phases_out: list[dict] = []
+        prev_hit_rate: float | None = None
+        n_base = max(requests // 4, concurrency)
+        n_surge = max(requests // 2, 2 * concurrency)
+        n_settle = max(requests - n_base - n_surge, concurrency)
+        phases = (
+            ("base", n_base, concurrency, None),
+            ("surge", n_surge, 2 * concurrency, 4),  # load doubles, 2 -> 4
+            ("settle", n_settle, concurrency, 2),  # load halves,  4 -> 2
+        )
+        for phase_name, n_requests, conc, scale_target in phases:
+            replicas_before = len(fleet.replicas)
+            tick = controller.tick()  # live signals off the real scrape
+            before = counters()
+            picks = rng.choice(images, size=n_requests, p=weights)
+            work = [
+                (pngs[i], json.dumps({
+                    "mpi_key": keys[i], "offsets": [[0.01, 0.0, 0.0]],
+                }).encode())
+                for i in picks
+            ]
+            work_lock = threading.Lock()
+            latencies: list[float] = []
+            errors: list[str] = []
+            scale_at = len(work) // 2 if scale_target is not None else -1
+            scaled_to: list[int] = []
+
+            def client():
+                hdr_png = {"Content-Type": "image/png"}
+                hdr_json = {"Content-Type": "application/json"}
+                while True:
+                    with work_lock:
+                        if not work:
+                            return
+                        png, render_payload = work.pop()
+                        fire_scale = len(work) == scale_at
+                    if fire_scale:
+                        # the membership change happens UNDER this flood,
+                        # from a client thread — the other clients keep
+                        # hammering through the join/drain window
+                        scaled_to.append(controller.scale_to(scale_target))
+                    t0 = time.perf_counter()
+                    c1, _ = _http(base, "/predict", data=png,
+                                  headers=hdr_png)
+                    c2, _ = _http(base, "/render", data=render_payload,
+                                  headers=hdr_json)
+                    dt = time.perf_counter() - t0
+                    with work_lock:
+                        if c1 == 200 and c2 == 200:
+                            latencies.append(dt)
+                        else:
+                            errors.append(f"predict={c1} render={c2}")
+
+            clients = [threading.Thread(target=client) for _ in range(conc)]
+            t0 = time.perf_counter()
+            for c in clients:
+                c.start()
+            for c in clients:
+                c.join(timeout=600)
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(
+                    f"ramp phase {phase_name!r}: {len(errors)}/{n_requests} "
+                    f"requests failed: {errors[0]}"
+                )
+            if scale_target is not None and (
+                    not scaled_to or scaled_to[0] != scale_target
+                    or len(fleet.replicas) != scale_target):
+                raise RuntimeError(
+                    f"ramp phase {phase_name!r}: wanted {scale_target} "
+                    f"replicas, got {scaled_to or 'no scale'} "
+                    f"(ring now {len(fleet.replicas)})"
+                )
+            after = counters()
+            enc_total = sum(c["enc"] for c in after.values())
+            if enc_total != float(images):
+                # the cache-aware claim, per transition: joins pre-warmed
+                # their arc, drains handed theirs off — NOTHING re-encoded
+                raise RuntimeError(
+                    f"ramp phase {phase_name!r}: fleet-wide "
+                    f"encoder_invocations {enc_total} != images {images} "
+                    "— a moved arc was re-encoded instead of pre-warmed"
+                )
+            d_hits = sum(
+                a["hits"] - before.get(n, {}).get("hits", 0.0)
+                for n, a in after.items()
+            )
+            d_misses = sum(
+                a["misses"] - before.get(n, {}).get("misses", 0.0)
+                for n, a in after.items()
+            )
+            hit_rate = round(d_hits / max(d_hits + d_misses, 1.0), 4)
+            all_latencies.extend(latencies)
+            phases_out.append({
+                "phase": phase_name,
+                "replicas_before": replicas_before,
+                "replicas_after": len(fleet.replicas),
+                "requests": n_requests, "concurrency": conc,
+                "elapsed_s": round(elapsed, 2),
+                "router_p50_ms": round(
+                    1e3 * float(np.percentile(latencies, 50)), 1),
+                "router_p95_ms": round(
+                    1e3 * float(np.percentile(latencies, 95)), 1),
+                "hit_rate": hit_rate,
+                "hit_rate_dip": (
+                    None if prev_hit_rate is None
+                    else round(prev_hit_rate - hit_rate, 4)
+                ),
+                "encoder_invocations_total": enc_total,
+                "controller_tick": {
+                    "action": tick["action"],
+                    "burn_rates": tick.get("burn_rates"),
+                    "router_p95_s": tick.get("router_p95_s"),
+                },
+            })
+            prev_hit_rate = hit_rate
+        slo_verdict = slo.verdict()
+
+        final = counters()
+        total_requests = sum(p["requests"] for p in phases_out)
+        total_elapsed = sum(p["elapsed_s"] for p in phases_out)
+        return {
+            "metric": RAMP_METRIC,
+            "value": round(total_requests / max(total_elapsed, 1e-9), 2),
+            "unit": "renders/sec",
+            "images": images, "requests": total_requests,
+            "concurrency": concurrency, "engine": "fake",
+            "replicas": "2-4-2",
+            "router_p50_ms": round(
+                1e3 * float(np.percentile(all_latencies, 50)), 1),
+            "router_p95_ms": round(
+                1e3 * float(np.percentile(all_latencies, 95)), 1),
+            "cache_hit_rate": phases_out[-1]["hit_rate"],
+            "encoder_invocations_total": sum(
+                c["enc"] for c in final.values()),
+            "conservation_ok": True,  # the per-phase gate enforces it
+            "zero_5xx": True,  # ditto (any non-200 raised)
+            "phases": phases_out,
+            "slo": slo_verdict,
+            "note": (
+                "elastic ramp through router+replica HTTP: load doubles "
+                "mid-flood, membership 2->4->2 changed UNDER traffic via "
+                "the autoscale join/drain protocols; zero non-200s and "
+                "fleet-wide encoder_invocations == images asserted after "
+                "both transitions"
+            ),
+        }
+    finally:
+        if fleet_srv is not None:
+            fleet_srv.shutdown()
+            fleet_srv.server_close()
+        if fleet is not None:
+            fleet.close()
+        pool.close()
+
+
 def _append_ledger_rows(result: dict, compare: dict | None,
                         args, compare_tier: str | None = None) -> list[dict]:
     """The dedicated fleet stream + one tier-keyed economics stream per
@@ -788,6 +1047,13 @@ def main() -> None:
                     "AOT warm pools, interleaved shapes in one skew trace, "
                     "mid-flood hot swap, compile counter asserted flat "
                     "(dedicated fleet_mixed_bucket ledger stream)")
+    ap.add_argument("--ramp", action="store_true",
+                    help="run the elastic-fleet ramp instead of the "
+                    "homogeneous trace: load doubles mid-flood, the "
+                    "autoscale controller scales 2->4->2 under traffic "
+                    "with cache-aware pre-warm/handoff; zero 5xx + "
+                    "encoder conservation asserted (dedicated fleet_scale "
+                    "ledger stream)")
     ap.add_argument("--zoo", action="store_true",
                     help="with --mixed-bucket: use the pretrained-zoo "
                     "capability-envelope shapes (RealEstate10K 256x384x64, "
@@ -799,6 +1065,57 @@ def main() -> None:
     from mine_tpu.utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
+
+    if args.ramp:
+        # the ramp is fake-engine fp32 at an unconstrained budget by
+        # construction (its gates are conservation and zero 5xx, not
+        # cache economics) — refuse rather than silently ignore, same
+        # contract as --mixed-bucket below
+        ignored = [
+            flag for flag, is_default in (
+                ("--mixed-bucket", not args.mixed_bucket),
+                ("--real", not args.real),
+                ("--tier", args.tier == "fp32"),
+                ("--prune-eps", args.prune_eps is None),
+                ("--cache-mb", args.cache_mb == 2048),
+                ("--no-peer-fetch", not args.no_peer_fetch),
+            ) if not is_default
+        ]
+        if ignored:
+            ap.error(
+                f"--ramp does not support {', '.join(ignored)}: the "
+                "elastic-ramp scenario runs fake-engine fp32 with the "
+                "peer-fetch wire on (its gates are encoder conservation "
+                "and zero 5xx across membership changes)"
+            )
+        result = run_ramp(
+            images=args.images, requests=args.requests,
+            concurrency=args.concurrency,
+        )
+        try:
+            import jax
+
+            from mine_tpu.obs import ledger
+
+            row = ledger.append_bench_row({
+                "metric": RAMP_METRIC, "value": result["value"],
+                "unit": "renders/sec", "higher_is_better": True,
+                "p50_ms": result["router_p50_ms"],
+                "p95_ms": result["router_p95_ms"],
+                "cache_hit_rate": result["cache_hit_rate"],
+                "device": jax.devices()[0].device_kind,
+                "backend": jax.default_backend(),
+            }, workload={
+                "images": args.images, "requests": args.requests,
+                "concurrency": args.concurrency,
+                "engine": "fake", "replicas": "2-4-2",
+            })
+            if row is not None:
+                result["ledger_rows"] = 1
+        except Exception as exc:  # noqa: BLE001 - number outranks ledger
+            print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+        print(json.dumps(result))
+        return
 
     if args.mixed_bucket:
         # the mixed-bucket scenario is fake-engine fp32 by construction —

@@ -39,6 +39,18 @@ router, mine_tpu/serving/fleet.py — control-plane truth needs no XLA):
   REJECTED with a named error + counter, the old generation still serves
   (follow-up requests 200), and nothing 5xxs.
 
+Scale half (in-process fake-weight elastic fleet: the autoscale
+controller, mine_tpu/serving/autoscale.py):
+  clean join 2 -> 3 mid-flood: the joiner pre-warms its future arc over
+  the peer-fetch wire BEFORE the router admits it — fleet-wide
+  encoder_invocations stays == images (cache-aware scaling) and nothing
+  5xxs. `join_stall@scale=1`: a join wedged during pre-warm NEVER enters
+  the ring (membership unchanged, the spawned replica retired, the abort
+  counted). `drain_timeout@scale=1`: a drain whose handoff wedges STILL
+  completes — the victim sheds, leaves the ring, and is retired with
+  zero 5xx; the only cost is cache warmth (measured as an
+  encoder-invocation delta, not gated).
+
 Multihost half (REAL jax.distributed multi-process training via
 tools/multihost_harness.py — N subprocesses on one box, the code path a
 pod runs; slow, run explicitly or via --half all):
@@ -79,8 +91,8 @@ against its hermetic fixture; the verdict names each config's stage
 outcomes. `tools/conformance_run.py` is the standalone spelling.
 
 Usage:
-  python tools/chaos_drill.py [--half training|serving|fleet|multihost|
-                               datasets|all]
+  python tools/chaos_drill.py [--half training|serving|fleet|scale|
+                               multihost|datasets|all]
                               [--workdir DIR] [--no-exact] [--steps N]
 """
 
@@ -641,6 +653,242 @@ def fleet_half(timeout_s: float) -> dict:
     return result
 
 
+def scale_half(timeout_s: float) -> dict:
+    """Elastic-fleet scale drill: the autoscale controller's join/drain
+    protocols (serving/autoscale.py) under flood, with the scale chaos
+    seams fired. Importable (tests run it compile-free).
+
+    phase A  clean join 2 -> 3 mid-flood: the joiner pre-warms its future
+             arc BEFORE the router admits it, so fleet-wide
+             encoder_invocations stays == images (cache-aware, gated) and
+             nothing 5xxs.
+    phase B  `join_stall@scale=1`: the joiner wedges during pre-warm. It
+             must NEVER enter the ring — membership stays 3, the spawned
+             replica is retired (pool has no stragglers), the abort is
+             counted (autoscale_events join/aborted), the flood sees no
+             5xx.
+    phase C  `drain_timeout@scale=1`: the handoff wedges mid-drain. The
+             drain STILL completes — the victim leaves the ring and is
+             retired, membership lands at 2, no request 5xxs; the cost is
+             cache warmth (survivors re-predict the cold arc), measured
+             as an encoder-invocation delta, and the abandoned handoff is
+             counted (autoscale_events drain/handoff_aborted).
+    """
+    import io
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+    from PIL import Image
+
+    from mine_tpu.obs.slo import SLOTracker, default_objectives
+    from mine_tpu.resilience import chaos
+    from mine_tpu.serving.autoscale import AutoscaleController, InProcessPool
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.fleet import FleetApp, make_fleet_server
+
+    result: dict = {}
+    pool = InProcessPool(app_factory=lambda: make_fake_app(
+        checkpoint_step=1,
+    ))
+    fleet = fleet_srv = None
+    images = 6
+    try:
+        for _ in range(2):
+            pool.spawn()
+        urls = pool.urls()
+        pool.configure_peers(urls)
+        fleet = FleetApp(urls, probe_interval_s=0.25, probe_timeout_s=2.0,
+                         up_after=2, down_after=2, max_attempts=3,
+                         deadline_s=15.0).start()
+        fleet_srv = make_fleet_server(fleet)
+        fh, fp = fleet_srv.server_address[:2]
+        threading.Thread(target=fleet_srv.serve_forever,
+                         daemon=True).start()
+        base = f"http://{fh}:{fp}"
+        controller = AutoscaleController(
+            fleet, pool, scrape=f"{base}/metrics",
+            min_replicas=2, max_replicas=4, up_after=10**6,
+            down_after=10**6, cooldown_s=0.0,
+            join_timeout_s=timeout_s, drain_timeout_s=timeout_s,
+        )
+
+        def http(path, data=None, headers=None, timeout=30.0):
+            req = urllib.request.Request(base + path, data=data,
+                                         headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as err:
+                return err.code, err.read()
+
+        pngs = []
+        for i in range(images):
+            img = np.full((8, 8, 3), (i * 43) % 256, np.uint8)
+            img[0, 0] = (i, 1, 0)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="PNG")
+            pngs.append(buf.getvalue())
+        keys = []
+        for png in pngs:
+            code, body = http("/predict", data=png,
+                              headers={"Content-Type": "image/png"})
+            assert code == 200, body
+            keys.append(json.loads(body)["mpi_key"])
+
+        def one_request(i: int) -> int:
+            """One logical client request honoring the documented 404
+            contract (re-predict, render again)."""
+            png, key = pngs[i % images], keys[i % images]
+            payload = json.dumps({
+                "mpi_key": key, "offsets": [[0.01, 0.0, 0.0]],
+            }).encode()
+            hdr = {"Content-Type": "application/json"}
+            code, _ = http("/render", data=payload, headers=hdr)
+            if code == 404:
+                pc, _ = http("/predict", data=png,
+                             headers={"Content-Type": "image/png"})
+                if pc != 200:
+                    return pc
+                code, _ = http("/render", data=payload, headers=hdr)
+            return code
+
+        def flood(n_threads: int, per_thread: int,
+                  mid_flood=None) -> list[int]:
+            codes: list[int] = []
+            lock = threading.Lock()
+
+            def client():
+                for i in range(per_thread):
+                    c = one_request(i)
+                    with lock:
+                        codes.append(c)
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            if mid_flood is not None:
+                time.sleep(0.15)  # let the flood establish
+                mid_flood()
+            for t in threads:
+                t.join(timeout=timeout_s)
+            return codes
+
+        def phase_slo() -> SLOTracker:
+            return SLOTracker(fleet.metrics.registry, default_objectives(
+                family_prefix="mine_fleet", p95_s=5.0,
+            ))
+
+        def encoder_total() -> float:
+            total = 0.0
+            for url in pool.urls().values():
+                req = urllib.request.Request(url + "/metrics")
+                with urllib.request.urlopen(req, timeout=10.0) as resp:
+                    text = resp.read().decode()
+                for line in text.splitlines():
+                    if line.startswith(
+                            "mine_serve_encoder_invocations_total "):
+                        total += float(line.rsplit(" ", 1)[1])
+            return total
+
+        events = fleet.metrics.autoscale_events
+
+        # ---- phase A: clean join 2 -> 3 mid-flood ---------------------------
+        slo_a = phase_slo()
+        scaled: list[int] = []
+        codes_a = flood(4, 30, mid_flood=lambda: scaled.append(
+            controller.scale_to(3)))
+        result["slo_join"] = slo_a.verdict()
+        result["join_scaled_to"] = scaled[0] if scaled else 0
+        result["join_ring_size"] = len(fleet.ring_members())
+        result["join_flood_codes"] = sorted(set(codes_a))
+        result["join_zero_5xx"] = all(c < 500 for c in codes_a)
+        enc_after_join = encoder_total()
+        # the cache-aware claim: the joiner's arc was PRE-warmed over the
+        # wire before admission — nothing was re-encoded
+        result["join_encoder_invocations"] = enc_after_join
+        result["join_conservation_ok"] = enc_after_join == float(images)
+        result["join_events_ok"] = events.value(
+            direction="join", outcome="ok")
+
+        # ---- phase B: join_stall — wedged join never enters the ring --------
+        slo_b = phase_slo()
+        schedule = chaos.install("join_stall@scale=1")
+        scaled_b: list[int] = []
+        codes_b = flood(4, 30, mid_flood=lambda: scaled_b.append(
+            controller.scale_to(4)))
+        result["stall_fired"] = schedule.pending() == []
+        chaos.uninstall()
+        result["slo_stall"] = slo_b.verdict()
+        # the wedged joiner must be invisible: ring unchanged, no straggler
+        # replica left in the pool, the abort counted
+        result["stall_ring_size"] = len(fleet.ring_members())
+        result["stall_scaled_to"] = scaled_b[0] if scaled_b else 0
+        result["stall_pool_size"] = len(pool.names())
+        result["stall_flood_codes"] = sorted(set(codes_b))
+        result["stall_zero_5xx"] = all(c < 500 for c in codes_b)
+        result["stall_events_aborted"] = events.value(
+            direction="join", outcome="aborted")
+
+        # ---- phase C: drain_timeout — drain completes without the handoff ---
+        slo_c = phase_slo()
+        enc_before_drain = encoder_total()
+        schedule = chaos.install("drain_timeout@scale=1")
+        scaled_c: list[int] = []
+        codes_c = flood(4, 30, mid_flood=lambda: scaled_c.append(
+            controller.scale_to(2)))
+        result["drain_fired"] = schedule.pending() == []
+        chaos.uninstall()
+        result["slo_drain"] = slo_c.verdict()
+        result["drain_ring_size"] = len(fleet.ring_members())
+        result["drain_scaled_to"] = scaled_c[0] if scaled_c else 0
+        result["drain_pool_size"] = len(pool.names())
+        result["drain_flood_codes"] = sorted(set(codes_c))
+        result["drain_zero_5xx"] = all(c < 500 for c in codes_c)
+        result["drain_events_handoff_aborted"] = events.value(
+            direction="drain", outcome="handoff_aborted")
+        # the abandoned handoff's price is cache warmth, not availability:
+        # survivors re-predict the cold arc (measured, NOT gated)
+        result["drain_reencode_delta"] = encoder_total() - enc_before_drain
+        codes_post = [one_request(i) for i in range(2 * images)]
+        result["post_drain_all_200"] = all(c == 200 for c in codes_post)
+
+        result["ok"] = (
+            result["join_scaled_to"] == 3
+            and result["join_ring_size"] == 3
+            and result["join_zero_5xx"]
+            and result["join_conservation_ok"]
+            and result["join_events_ok"] >= 1
+            and result["slo_join"]["ok"]
+            and result["stall_fired"]
+            and result["stall_ring_size"] == 3
+            and result["stall_scaled_to"] == 3
+            and result["stall_pool_size"] == 3
+            and result["stall_zero_5xx"]
+            and result["stall_events_aborted"] >= 1
+            and result["slo_stall"]["ok"]
+            and result["drain_fired"]
+            and result["drain_ring_size"] == 2
+            and result["drain_scaled_to"] == 2
+            and result["drain_pool_size"] == 2
+            and result["drain_zero_5xx"]
+            and result["drain_events_handoff_aborted"] >= 1
+            and result["slo_drain"]["ok"]
+            and result["post_drain_all_200"]
+        )
+    finally:
+        chaos.uninstall()
+        if fleet_srv is not None:
+            fleet_srv.shutdown()
+            fleet_srv.server_close()  # shutdown() alone leaks the fd
+        if fleet is not None:
+            fleet.close()
+        pool.close()
+    return result
+
+
 # tiny config for the REAL multi-process runs (1 CPU device per host).
 # SGD: cross-topology (4-host -> 3-host) parity only holds fp-epsilon under
 # an update linear in the gradient (PR 7 methodology; training.optimizer).
@@ -984,7 +1232,7 @@ def datasets_half(workdir: str, timeout_s: float) -> dict:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--half",
-                        choices=("training", "serving", "fleet",
+                        choices=("training", "serving", "fleet", "scale",
                                  "multihost", "datasets", "all"),
                         default="all",
                         help="'datasets' sweeps the full dataset-"
@@ -1021,6 +1269,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.half in ("fleet", "all"):
             verdict["fleet"] = fleet_half(args.timeout_s)
             ok = ok and verdict["fleet"]["ok"]
+        if args.half in ("scale", "all"):
+            verdict["scale"] = scale_half(args.timeout_s)
+            ok = ok and verdict["scale"]["ok"]
         if args.half in ("multihost", "all"):
             verdict["multihost"] = multihost_half(workdir, args.timeout_s)
             ok = ok and verdict["multihost"]["ok"]
