@@ -222,6 +222,11 @@ class ResilienceConfig:
     breaker_failure_threshold: int = 5
     # seconds the breaker stays open before half-opening for one trial
     breaker_reset_s: float = 30.0
+    # seeded jitter (+-fraction of breaker_reset_s) applied to each trip's
+    # recovery window, so N replicas tripped by one fleet-wide event do
+    # not run their half-open trials in lockstep (a synchronized re-probe
+    # stampede re-trips every breaker at once). 0 keeps the exact window.
+    breaker_reset_jitter: float = 0.2
     # cross-host stall watchdog (resilience/multihost.py): on multi-process
     # runs every host writes a heartbeat file at each log-interval sync; a
     # host whose heartbeat goes stale by more than this window — killed, or
@@ -317,6 +322,37 @@ class ServingConfig:
     # drain still completes — survivors fall back to peer-fetch while the
     # victim is alive, then re-predict
     autoscale_drain_timeout_s: float = 30.0
+    # --- brownout degradation ladder (serving/degrade.py) ---------------
+    # Load-adaptive fidelity degradation engaged BEFORE any 503 shed:
+    # L0 normal -> L1 int8+pruned predicts -> L2 stale-while-revalidate
+    # -> L3 widened coalescing, with the existing shed only past L3.
+    # Off by default: the ladder is an operating MODE — tools/
+    # bench_fleet.py --brownout and tools/chaos_drill.py --half brownout
+    # prove the availability trade before a fleet turns it on.
+    degrade_enabled: bool = False
+    # breach/calm thresholds on the batcher queue fraction (depth over
+    # serve_max_queue_requests) and the worst SLO burn rate; between the
+    # high and low marks is a deadband where the ladder holds position
+    degrade_queue_high: float = 0.75
+    degrade_queue_low: float = 0.25
+    degrade_burn_high: float = 2.0
+    degrade_burn_low: float = 0.5
+    # hysteresis: escalate one level after `engage_after` CONSECUTIVE
+    # breach ticks; relax one level after `relax_after` consecutive calm
+    # ticks AND `dwell_s` of residency at the current level (escalation
+    # is deliberately faster — availability is the emergency)
+    degrade_engage_after: int = 2
+    degrade_relax_after: int = 3
+    degrade_dwell_s: float = 5.0
+    # ladder ceiling (0..3); lower to cap how much fidelity may be traded
+    degrade_max_level: int = 3
+    # the L3 coalescing window (replaces batcher max_delay_ms while L3
+    # holds; restored on relax)
+    degrade_coalesce_delay_ms: float = 25.0
+    # fleet degradation level at/above which the autoscaler counts a
+    # sustained-breach tick (the brownout fast path asks the slow path
+    # for capacity); 0 disables the coupling
+    degrade_scaleup_level: int = 1
 
 
 @dataclass(frozen=True)

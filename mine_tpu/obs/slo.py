@@ -358,6 +358,23 @@ def burn_rates_from_exposition(text: str) -> dict[str, float]:
     }
 
 
+def degradation_from_exposition(
+    text: str, family: str = "mine_fleet_degradation_level"
+) -> float | None:
+    """The brownout degradation level on a /metrics page (serving/
+    degrade.py): the router's fleet-wide aggregate by default, or a
+    replica's own `mine_serve_degradation_level` when pointed at one.
+    None when the page carries no such gauge (a ladder-less deployment,
+    or a router that has not forwarded since brownout landed) — no
+    signal, distinct from a healthy 0. Used by the autoscale controller:
+    sustained level >= serving.degrade_scaleup_level is a scale-up
+    signal (the brownout fast path asking the slow path for capacity)."""
+    samples = _exposition_children(text, family)
+    if not samples:
+        return None
+    return max(value for _, value in samples)
+
+
 def p95_from_exposition(
     text: str,
     family: str = "mine_fleet_request_latency_seconds",

@@ -13,6 +13,14 @@ breaker converts that failure mode into fast, honest load shedding:
              trial request; its success closes the breaker, its failure
              re-opens it (timer restarts).
 
+The recovery window is JITTERED (`reset_jitter`, a seeded +-fraction
+drawn per trip): a fleet-wide event — a bad checkpoint push, a shared
+backend hiccup — trips every replica's breaker at the same instant, and
+without jitter every replica would run its half-open trial in lockstep,
+stampeding the still-recovering dependency and re-tripping together.
+Seeded (`jitter_seed`, distinct per replica) so the spread is
+deterministic under test yet distinct across the fleet.
+
 Thread-safe; time is injectable for deterministic tests. State changes are
 reported through `on_state` (a gauge hook: 0 closed, 1 half-open, 2 open)
 and trips through `on_trip` (a counter hook).
@@ -20,6 +28,7 @@ and trips through `on_trip` (a counter hook).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable
@@ -46,13 +55,26 @@ class CircuitBreaker:
         clock: Callable[[], float] = time.monotonic,
         on_state: Callable[[int], None] | None = None,
         on_trip: Callable[[], None] | None = None,
+        reset_jitter: float = 0.0,
+        jitter_seed: int | None = None,
     ):
         if failure_threshold < 0:
             raise ValueError(f"failure_threshold must be >= 0, got "
                              f"{failure_threshold}")
+        if not 0.0 <= reset_jitter < 1.0:
+            raise ValueError(
+                f"reset_jitter must be in [0, 1), got {reset_jitter}"
+            )
         # threshold 0 disables the breaker entirely (allow() is always True)
         self.failure_threshold = int(failure_threshold)
         self.reset_after_s = float(reset_after_s)
+        self.reset_jitter = float(reset_jitter)
+        self._jitter_rng = random.Random(
+            0 if jitter_seed is None else jitter_seed
+        )
+        # the window actually in force for the CURRENT open period;
+        # re-drawn at every trip (guarded-by: self._lock)
+        self._effective_reset_s = self.reset_after_s
         self._clock = clock
         self._on_state = on_state
         self._on_trip = on_trip
@@ -80,7 +102,8 @@ class CircuitBreaker:
 
     def _maybe_half_open_locked(self) -> None:
         if (self._state == OPEN
-                and self._clock() - self._opened_at >= self.reset_after_s):
+                and self._clock() - self._opened_at
+                >= self._effective_reset_s):
             self._set_state_locked(HALF_OPEN)
             self._trial_inflight = False
 
@@ -90,7 +113,8 @@ class CircuitBreaker:
             if self._state != OPEN:
                 return 0.0
             return max(
-                0.0, self.reset_after_s - (self._clock() - self._opened_at)
+                0.0,
+                self._effective_reset_s - (self._clock() - self._opened_at),
             )
 
     # -- admission ------------------------------------------------------------
@@ -140,6 +164,12 @@ class CircuitBreaker:
             )
             if should_trip:
                 self._opened_at = self._clock()
+                # draw this open period's recovery window: replicas
+                # sharing a trip instant still re-probe at distinct ones
+                self._effective_reset_s = self.reset_after_s * (
+                    1.0 + self.reset_jitter
+                    * self._jitter_rng.uniform(-1.0, 1.0)
+                )
                 if self._state != OPEN:
                     self.trips += 1
                     if self._on_trip is not None:

@@ -43,6 +43,21 @@ Kinds and their seams:
                        stand-in for a corrupt/truncated checkpoint file;
                        proves rejected-swap rollback: old generation keeps
                        serving, named error + counter, no 5xx).
+  corrupt_ckpt@swap=N  serving/server.py's hot-swap worker surfaces
+                       CheckpointCorrupt on the Nth swap's integrity
+                       verification (the in-process stand-in for a
+                       checkpoint whose sha256-of-manifest sidecar no
+                       longer matches its bytes — training/checkpoint.py
+                       verify_checkpoint_integrity); proves the NAMED
+                       corrupt-rejection path: swap refused with
+                       reason=corrupt, old generation keeps serving.
+  overload_spike@request=N  serving/server.py injects synthetic overload
+                       into the brownout degradation controller on its
+                       Nth handled request (serving/degrade.py inject):
+                       the next ticks classify as breach whatever the
+                       real signals say, so the drill proves the full
+                       ladder climb, per-level announcement, and the
+                       one-step-at-a-time recovery deterministically.
   replica_kill@request=N  serving/server.py kills THIS replica's HTTP
                        server on its Nth handled request: the listener
                        closes and the triggering connection drops with no
@@ -108,7 +123,9 @@ KINDS: dict[str, str] = {
     "engine_raise": "render",
     "predict_raise": "predict",
     "corrupt_swap": "swap",
+    "corrupt_ckpt": "swap",
     "replica_kill": "request",
+    "overload_spike": "request",
     "host_kill": "step",
     "host_stall": "step",
     "coord_down": "init",

@@ -2,17 +2,22 @@
 
 Closes the loop from the SLO layer (obs/slo.py) into live ring
 membership (serving/fleet.py). The controller scrapes the fleet
-router's /metrics exposition on a fixed interval and reads two signals:
+router's /metrics exposition on a fixed interval and reads three signals:
 
   - `mine_slo_burn_rate` — how fast each objective is eating its error
     budget (1.0 = exactly at target);
   - router p95, interpolated from the `mine_fleet_request_latency_seconds`
-    cumulative histogram (obs.slo.p95_from_exposition).
+    cumulative histogram (obs.slo.p95_from_exposition);
+  - `mine_fleet_degradation_level` — the worst brownout-ladder level any
+    replica announced (serving/degrade.py): sustained degradation is
+    overload even while every request still answers 200.
 
 Hysteresis turns signals into decisions: scale UP after `up_after`
-CONSECUTIVE breached ticks (any burn rate >= the up threshold, or p95
-over its ceiling), scale DOWN after `down_after` consecutive calm ticks
-(every burn rate <= the down threshold) — down is deliberately slower
+CONSECUTIVE breached ticks (any burn rate >= the up threshold, p95 over
+its ceiling, or the fleet degradation level at/above
+`serving.degrade_scaleup_level`), scale DOWN after `down_after`
+consecutive calm ticks (every burn rate <= the down threshold AND the
+fleet back at L0) — down is deliberately slower
 and stricter, because flapping costs a pre-warm each way. A cooldown
 blocks any new event until the previous one has had time to reach the
 rolling SLO windows, and membership is clamped to
@@ -71,7 +76,11 @@ import time
 from typing import Any, Callable
 
 from mine_tpu.config import Config
-from mine_tpu.obs.slo import burn_rates_from_exposition, p95_from_exposition
+from mine_tpu.obs.slo import (
+    burn_rates_from_exposition,
+    degradation_from_exposition,
+    p95_from_exposition,
+)
 from mine_tpu.resilience import chaos
 from mine_tpu.serving.fleet import (
     DEFAULT_VNODES,
@@ -386,6 +395,7 @@ class AutoscaleController:
         join_timeout_s: float = 30.0,
         drain_timeout_s: float = 30.0,
         p95_up_threshold_s: float | None = None,
+        degrade_up_level: int = 0,
         scrape_timeout_s: float = 5.0,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -409,6 +419,10 @@ class AutoscaleController:
         self.join_timeout_s = float(join_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self.p95_up_threshold_s = p95_up_threshold_s
+        # brownout coupling (serving/degrade.py): a fleet-wide ladder
+        # level >= this sustains a breach — degraded fidelity is capacity
+        # debt the slow path (more replicas) pays back; 0 disables
+        self.degrade_up_level = int(degrade_up_level)
         self.scrape_timeout_s = float(scrape_timeout_s)
         self.clock = clock
         # _lock guards the decision state (cheap, never held over I/O);
@@ -458,14 +472,16 @@ class AutoscaleController:
             return {"action": "hold", "reason": "scrape_failed"}
         burns = burn_rates_from_exposition(text)
         p95 = p95_from_exposition(text)
+        level = degradation_from_exposition(text)
         with self._scale_lock:
             current = len(self.fleet.replicas)
             with self._lock:
-                action = self._decide_locked(burns, p95, current, now)
+                action = self._decide_locked(burns, p95, level, current, now)
             self.fleet.metrics.autoscale_decisions.inc(action=action)
             record = {
                 "action": action, "replicas": current,
                 "burn_rates": burns, "router_p95_s": p95,
+                "degradation_level": level,
             }
             if action == "scale_up":
                 record["ok"] = self._join_locked()
@@ -475,16 +491,26 @@ class AutoscaleController:
         return record
 
     def _decide_locked(self, burns: dict[str, float], p95: float | None,
-                       current: int, now: float) -> str:
+                       level: float | None, current: int, now: float) -> str:
         breach = any(
             b >= self.up_burn_threshold for b in burns.values()
         )
         if (not breach and self.p95_up_threshold_s is not None
                 and p95 is not None):
             breach = p95 >= self.p95_up_threshold_s
+        if (not breach and self.degrade_up_level > 0 and level is not None):
+            # sustained brownout IS overload even while every request still
+            # answers 200 — the ladder bought availability by spending
+            # fidelity; scaling up is what buys the fidelity back
+            breach = level >= self.degrade_up_level
         calm = not breach and all(
             b <= self.down_burn_threshold for b in burns.values()
         )
+        if calm and self.degrade_up_level > 0 and level is not None:
+            # no scale-DOWN while any replica is still degraded: L0
+            # stability is the all-clear, shrinking a browned-out fleet
+            # would re-trigger the ladder it just climbed down from
+            calm = level <= 0
         if breach:
             self._breach_ticks += 1
             self._calm_ticks = 0
@@ -686,6 +712,7 @@ def controller_from_config(
         join_timeout_s=s.autoscale_join_timeout_s,
         drain_timeout_s=s.autoscale_drain_timeout_s,
         p95_up_threshold_s=s.slo_p95_ms / 1000.0,
+        degrade_up_level=s.degrade_scaleup_level,
         clock=clock,
     )
 

@@ -216,6 +216,26 @@ class MicroBatcher:
         with self._cond:
             return len(self._pending)
 
+    def queue_frac(self) -> float:
+        """Queue depth over its admission bound — the degradation
+        ladder's primary pressure signal (serving/degrade.py). 0.0 when
+        the queue is unbounded (no bound means no queue-full shed to
+        preempt)."""
+        if not self.max_queue_requests:
+            return 0.0
+        return self.queue_depth() / self.max_queue_requests
+
+    def set_max_delay_s(self, delay_s: float) -> None:
+        """Retarget the coalescing window live (brownout L3 widens it,
+        relax restores it). Groups already waiting re-read the attribute
+        when the worker sizes their wait, so a widening takes effect on
+        the CURRENT queue, not just future submissions."""
+        self.max_delay_s = max(0.0, float(delay_s))
+        with self._cond:
+            # the worker may be sleeping on the old, shorter deadline;
+            # wake it so the new window is applied immediately
+            self._cond.notify_all()
+
     # -- worker --------------------------------------------------------------
 
     def _gauge_locked(self) -> None:

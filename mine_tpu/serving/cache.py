@@ -146,6 +146,24 @@ class MPICache:
                     break
         return out
 
+    def stale_key(self, key: CacheKey) -> CacheKey | None:
+        """Stale-while-revalidate lookup (serving/degrade.py L2): the
+        newest RESIDENT key for the same scene at the same shape bucket —
+        same digest/H/W/S, ANY tier — whose checkpoint step is older than
+        `key`'s. Post-swap, the old generation's entries are exactly
+        these: under brownout they keep serving instead of forcing a
+        re-predict per scene. Returns None when nothing stale is
+        resident (the caller falls through to the normal miss path)."""
+        digest, step, h, w, s, _ = key
+        best: CacheKey | None = None
+        with self._lock:
+            for cand in self._entries:
+                if (cand[0] == digest and cand[2:5] == (h, w, s)
+                        and cand[1] < step
+                        and (best is None or cand[1] > best[1])):
+                    best = cand
+        return best
+
     def get(self, key: CacheKey, record: bool = True) -> Any | None:
         """Lookup + LRU touch. record=False skips the hit/miss counters —
         for internal re-checks (the predict singleflight's under-lock peek)

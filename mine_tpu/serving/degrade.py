@@ -1,0 +1,299 @@
+"""Brownout serving: a load-adaptive degradation ladder (ISSUE 19).
+
+The fleet's only answer to overload used to be shedding: bounded queue ->
+503, breaker open -> 503, drain -> 503. Yet the compression ladder
+(serving/compress.py) made MPI fidelity a continuously tradeable budget
+knob — "Compact and adaptive multiplane images" (arxiv 2102.10086) and
+"Adaptive Multiplane Image Generation from a Single Internet Picture"
+(arxiv 2011.13317) both show an MPI tolerates aggressive compaction at
+negligible PSNR cost. This module spends that budget under pressure:
+a per-replica `DegradationController` maps live pressure signals (batcher
+queue depth, SLO burn rate, breaker state) onto an ordered ladder of
+cheaper serving modes engaged BEFORE any shed:
+
+  L0 normal    full-fidelity serving, the configured operating point.
+  L1 compress  new predicts land in the int8 tier with default-eps
+               transmittance pruning: quarter slab bytes, fewer planes,
+               smaller render buckets — cache capacity and render FLOPs
+               reclaimed without touching a single request's admission.
+  L2 stale     stale-while-revalidate: on a cache miss an older-step
+               entry of the same scene keeps serving (post-swap, the old
+               generation's `mpi_key`s stay servable instead of forcing
+               re-predicts); the peer-fetch hop is skipped — answer from
+               what is resident, now.
+  L3 coalesce  the micro-batcher's coalescing window widens so more
+               same-scene renders amortize one dispatch; only past this
+               does the existing 503 shed fire for the remainder.
+
+The state machine is the autoscale controller's idiom (serving/
+autoscale.py): an injectable clock, consecutive-tick hysteresis in both
+directions, and a minimum per-level dwell before relaxing — escalation is
+deliberately faster than relaxation (availability is the emergency;
+fidelity restoration can wait for the dwell). Transitions move ONE level
+at a time in BOTH directions: the ladder never skips a level downward,
+so every intermediate mode's exit path is exercised on every recovery.
+
+Every degraded response announces itself (`X-Degraded: level=<n>;tier=<t>`
+header, `mine_serve_degradation_{level,responses_total}`), is SLO-visible
+but 5xx-exempt; the fleet router aggregates a fleet-wide level and the
+autoscaler treats sustained L>=1 as a scale-up signal (the slow path that
+restores full fidelity once capacity arrives) and L0 stability as the
+all-clear to relax.
+
+Everything here is a pure host-side state machine — no clocks started, no
+threads, no jax — so tests drive it entirely on a fake clock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from mine_tpu.serving.compress import DEFAULT_PRUNE_EPS
+
+# The ladder, level -> (name, what it trades). README's "Graceful
+# degradation" table is drift-tested against this in both directions
+# (tests/test_degrade.py, the test_metrics_docs idiom), so a new level
+# added here without its row — or a stale row — fails tier-1.
+LADDER: dict[int, tuple[str, str]] = {
+    0: ("normal", "full fidelity at the configured operating point"),
+    1: ("compress", "new predicts land in the int8 tier + default-eps "
+        "pruning (quarter slab bytes, smaller render buckets)"),
+    2: ("stale", "stale-while-revalidate: older-generation cache entries "
+        "keep serving on a miss; peer-fetch skipped"),
+    3: ("coalesce", "micro-batcher coalescing window widened; only past "
+        "this does the 503 shed fire"),
+}
+MAX_LEVEL = max(LADDER)
+
+
+@dataclass(frozen=True)
+class PressureSample:
+    """One tick's pressure inputs, gathered by the caller (the serving
+    app) from the live components: queue_frac = batcher depth over its
+    bound, burn_rate = the worst `mine_slo_burn_rate` the tracker last
+    published, breaker_open = admission already rejecting."""
+
+    queue_frac: float = 0.0
+    burn_rate: float = 0.0
+    breaker_open: bool = False
+
+
+class DegradationController:
+    """The per-replica ladder state machine.
+
+    tick() classifies a PressureSample as breach / calm / deadband:
+
+      breach  queue_frac >= queue_high OR burn_rate >= burn_high OR the
+              breaker is open (or a synthetic overload is injected —
+              the `overload_spike` chaos seam). `engage_after`
+              consecutive breach ticks escalate ONE level.
+      calm    queue_frac <= queue_low AND burn_rate <= burn_low AND the
+              breaker closed. `relax_after` consecutive calm ticks AND
+              `dwell_s` of residency at the current level relax ONE
+              level — slower and stricter than escalation by design.
+      deadband anything between the thresholds resets both streaks:
+              the ladder holds position instead of flapping.
+
+    All time comes from the injected clock; nothing here sleeps or
+    spawns. Thread-safe: ticks arrive from every handler thread.
+    """
+
+    def __init__(
+        self,
+        *,
+        queue_high: float = 0.75,
+        queue_low: float = 0.25,
+        burn_high: float = 2.0,
+        burn_low: float = 0.5,
+        engage_after: int = 2,
+        relax_after: int = 3,
+        dwell_s: float = 5.0,
+        max_level: int = MAX_LEVEL,
+        clock=time.monotonic,
+        on_level=None,
+    ):
+        if not 0 <= queue_low <= queue_high:
+            raise ValueError(
+                f"need 0 <= queue_low <= queue_high, "
+                f"got {queue_low}/{queue_high}"
+            )
+        if not 0 <= burn_low <= burn_high:
+            raise ValueError(
+                f"need 0 <= burn_low <= burn_high, got {burn_low}/{burn_high}"
+            )
+        if engage_after < 1 or relax_after < 1:
+            raise ValueError(
+                f"engage_after/relax_after must be >= 1, "
+                f"got {engage_after}/{relax_after}"
+            )
+        if dwell_s < 0:
+            raise ValueError(f"dwell_s must be >= 0, got {dwell_s}")
+        if not 0 <= max_level <= MAX_LEVEL:
+            raise ValueError(
+                f"max_level must be in [0, {MAX_LEVEL}], got {max_level}"
+            )
+        self.queue_high = float(queue_high)
+        self.queue_low = float(queue_low)
+        self.burn_high = float(burn_high)
+        self.burn_low = float(burn_low)
+        self.engage_after = int(engage_after)
+        self.relax_after = int(relax_after)
+        self.dwell_s = float(dwell_s)
+        self.max_level = int(max_level)
+        self._clock = clock
+        self._on_level = on_level
+        self._lock = threading.Lock()
+        # guarded-by: self._lock
+        self._level = 0
+        self._level_since = float(clock())
+        self._breach_ticks = 0
+        self._calm_ticks = 0
+        self._synthetic_ticks = 0
+        self._transitions: list[tuple[float, int]] = [(self._level_since, 0)]
+        self._degraded_responses = 0
+
+    # -- the state machine ----------------------------------------------------
+
+    def tick(self, sample: PressureSample, now: float | None = None) -> int:
+        """Advance one observation; returns the (possibly new) level."""
+        callback = None
+        with self._lock:
+            now = float(self._clock()) if now is None else float(now)
+            synthetic = self._synthetic_ticks > 0
+            if synthetic:
+                self._synthetic_ticks -= 1
+            breach = (
+                synthetic
+                or sample.breaker_open
+                or sample.queue_frac >= self.queue_high
+                or sample.burn_rate >= self.burn_high
+            )
+            calm = (
+                not breach
+                and not sample.breaker_open
+                and sample.queue_frac <= self.queue_low
+                and sample.burn_rate <= self.burn_low
+            )
+            if breach:
+                self._calm_ticks = 0
+                self._breach_ticks += 1
+                if (self._breach_ticks >= self.engage_after
+                        and self._level < self.max_level):
+                    callback = self._move_locked(self._level + 1, now)
+            elif calm:
+                self._breach_ticks = 0
+                self._calm_ticks += 1
+                if (self._calm_ticks >= self.relax_after
+                        and self._level > 0
+                        and now - self._level_since >= self.dwell_s):
+                    callback = self._move_locked(self._level - 1, now)
+            else:
+                # deadband: hold position, restart both streaks
+                self._breach_ticks = 0
+                self._calm_ticks = 0
+            level = self._level
+        if callback is not None and self._on_level is not None:
+            self._on_level(level)
+        return level
+
+    def _move_locked(self, level: int, now: float) -> bool:
+        self._level = level
+        self._level_since = now
+        self._breach_ticks = 0
+        self._calm_ticks = 0
+        self._transitions.append((now, level))
+        return True
+
+    def inject(self, ticks: int | None = None) -> None:
+        """Synthetic overload (the `overload_spike@request=N` chaos seam):
+        the next `ticks` observations classify as breach whatever the real
+        signals say. The default is exactly enough consecutive breaches to
+        walk the ladder to max_level, so a drill proves the full climb AND
+        the full one-step-at-a-time descent deterministically."""
+        if ticks is None:
+            ticks = self.engage_after * self.max_level + 1
+        with self._lock:
+            self._synthetic_ticks = max(self._synthetic_ticks, int(ticks))
+
+    # -- level semantics (what each rung actually changes) --------------------
+
+    @property
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def level_since(self) -> float:
+        with self._lock:
+            return self._level_since
+
+    def tier_override(self) -> str | None:
+        """L>=1: new predicts compress to int8 (quarter slab bytes)."""
+        return "int8" if self.level >= 1 else None
+
+    def prune_eps_override(self) -> float:
+        """L>=1: default-eps transmittance pruning joins the tier drop."""
+        return DEFAULT_PRUNE_EPS if self.level >= 1 else 0.0
+
+    def serve_stale(self) -> bool:
+        """L>=2: an older-step cache entry of the same scene answers a
+        miss (stale-while-revalidate) instead of forcing a re-predict."""
+        return self.level >= 2
+
+    def skip_peer_fetch(self) -> bool:
+        """L>=2: the peer-fetch hop is skipped — under pressure the wire
+        round-trip is latency spent on fidelity nobody can afford."""
+        return self.level >= 2
+
+    def widen_coalesce(self) -> bool:
+        """L3: the micro-batcher coalescing window widens so more renders
+        amortize each dispatch; the 503 shed only fires past this."""
+        return self.level >= 3
+
+    def announcement(self, tier: str) -> str:
+        """The X-Degraded header value for a response served at the
+        current level with effective tier `tier`."""
+        return f"level={self.level};tier={tier}"
+
+    def record_response(self) -> None:
+        with self._lock:
+            self._degraded_responses += 1
+
+    def snapshot(self) -> dict:
+        """State for /healthz and the drill's assertions."""
+        with self._lock:
+            return {
+                "level": self._level,
+                "name": LADDER[self._level][0],
+                "level_since": self._level_since,
+                "breach_ticks": self._breach_ticks,
+                "calm_ticks": self._calm_ticks,
+                "degraded_responses": self._degraded_responses,
+            }
+
+    def transitions(self) -> list[tuple[float, int]]:
+        """Every (time, level) the ladder has visited, seed L0 included —
+        the drill asserts each step is exactly +-1 (never skips a level)."""
+        with self._lock:
+            return list(self._transitions)
+
+
+def controller_from_config(
+    cfg, clock=time.monotonic, on_level=None
+) -> DegradationController:
+    """Build the controller from the `serving.degrade_*` knobs
+    (mine_tpu/configs/default.yaml documents each; the config-knob-drift
+    lint keeps this mapping and the yaml in sync)."""
+    return DegradationController(
+        queue_high=cfg.serving.degrade_queue_high,
+        queue_low=cfg.serving.degrade_queue_low,
+        burn_high=cfg.serving.degrade_burn_high,
+        burn_low=cfg.serving.degrade_burn_low,
+        engage_after=cfg.serving.degrade_engage_after,
+        relax_after=cfg.serving.degrade_relax_after,
+        dwell_s=cfg.serving.degrade_dwell_s,
+        max_level=cfg.serving.degrade_max_level,
+        clock=clock,
+        on_level=on_level,
+    )

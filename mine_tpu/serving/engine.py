@@ -277,6 +277,15 @@ class RenderEngine:
                 f"serving.prune_transmittance_eps={self.prune_eps} must be "
                 "in [0, 1) — it thresholds a compositing weight"
             )
+        # brownout degradation override (serving/degrade.py L1): while set,
+        # NEW predicts compress at these knobs instead of the configured
+        # operating point. A caller that mints a tier-qualified cache key
+        # must read the effective knobs ONCE and pass them into predict()
+        # explicitly — key and entry then agree across a concurrent
+        # level flip (the WeightSet snapshot discipline, applied to the
+        # compression operating point).
+        self._degraded_tier: str | None = None
+        self._degraded_prune_eps: float = 0.0
         # Serving defaults to the STREAMING compositor regardless of the
         # checkpoint's training-time knob: render-many never materializes
         # the warped (N_poses, S, H, W, C) slabs, so the resident-MPI render
@@ -353,6 +362,37 @@ class RenderEngine:
         straddle a swap and cache a new-generation MPI under the old
         step's key."""
         return self._weights
+
+    # -- degraded compression override (serving/degrade.py L1) ----------------
+
+    def set_degraded_compression(self, tier: str, prune_eps: float) -> None:
+        """Engage the brownout compression operating point: NEW predicts
+        land at `tier` with at least `prune_eps` pruning (the configured
+        eps still applies if it is stricter). Cached entries are
+        untouched — the tier is part of their keys."""
+        if tier not in TIERS:
+            raise ValueError(f"degraded tier {tier!r} must be one of {TIERS}")
+        if not 0.0 <= float(prune_eps) < 1.0:
+            raise ValueError(
+                f"degraded prune_eps={prune_eps} must be in [0, 1)"
+            )
+        self._degraded_prune_eps = float(prune_eps)
+        self._degraded_tier = tier
+
+    def clear_degraded_compression(self) -> None:
+        self._degraded_tier = None
+        self._degraded_prune_eps = 0.0
+
+    def effective_tier(self) -> str:
+        """The tier NEW predicts land at right now (cache-key part).
+        Callers mint the key from one read and pass the same value into
+        predict(tier=...) so key and entry cannot straddle a flip."""
+        return self._degraded_tier or self.cache_tier
+
+    def effective_prune_eps(self) -> float:
+        if self._degraded_tier is None:
+            return self.prune_eps
+        return max(self.prune_eps, self._degraded_prune_eps)
 
     def swap_weights(
         self,
@@ -545,6 +585,8 @@ class RenderEngine:
         self, image: np.ndarray, spec: BucketSpec | None = None,
         request_id: str | None = None,
         weights: WeightSet | None = None,
+        tier: str | None = None,
+        prune_eps: float | None = None,
     ) -> MPIEntry | CompressedMPI:
         """Run the encoder-decoder once; returns the device-resident cache
         value at the engine's tier — a plain MPIEntry at fp32 with pruning
@@ -558,6 +600,14 @@ class RenderEngine:
         caller's cache key and this dispatch are guaranteed the same
         generation across a concurrent hot swap; defaults to the serving
         generation at call time.
+
+        tier/prune_eps: explicit compression operating point — the same
+        snapshot discipline as `weights`, for the degradation ladder: the
+        caller that minted a tier-qualified cache key passes the values
+        it minted from (engine.effective_tier()/effective_prune_eps()),
+        so the entry always lands at its key's tier even when a brownout
+        level flips mid-predict. Default: the effective knobs at call
+        time.
         """
         from mine_tpu.inference.video import prepare_image
 
@@ -572,7 +622,10 @@ class RenderEngine:
             mpi_rgb, mpi_sigma, disparity = self._dispatch_predict(
                 bucket, img, ws.variables
             )
-            entry = self._compress(bucket, mpi_rgb, mpi_sigma, disparity)
+            entry = self._compress(
+                bucket, mpi_rgb, mpi_sigma, disparity,
+                tier=tier, prune_eps=prune_eps,
+            )
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
             if bucket.predict_cost is not None and bucket.predict_cost.flops:
@@ -581,15 +634,19 @@ class RenderEngine:
                 )
         return entry
 
-    def _compress(self, bucket: _Bucket, mpi_rgb, mpi_sigma, disparity):
-        """Predict output -> cache value at the engine's tier/prune knobs.
-        The fp32 + pruning-off fast path is a numerics no-op: the device
-        arrays the executable produced ARE the entry (PARITY.md 5.11);
-        otherwise compression runs host-side (one device_get per predict)
-        and the compressed fields are re-placed on device."""
+    def _compress(self, bucket: _Bucket, mpi_rgb, mpi_sigma, disparity,
+                  tier: str | None = None, prune_eps: float | None = None):
+        """Predict output -> cache value at the given (or effective)
+        tier/prune knobs. The fp32 + pruning-off fast path is a numerics
+        no-op: the device arrays the executable produced ARE the entry
+        (PARITY.md 5.11); otherwise compression runs host-side (one
+        device_get per predict) and the compressed fields are re-placed
+        on device."""
         entry = compress_mpi(
             mpi_rgb, mpi_sigma, disparity, bucket.k, bucket=bucket.spec,
-            tier=self.cache_tier, prune_eps=self.prune_eps,
+            tier=self.effective_tier() if tier is None else tier,
+            prune_eps=(self.effective_prune_eps() if prune_eps is None
+                       else prune_eps),
             use_alpha=bucket.cfg.mpi.use_alpha,
         )
         if (self.metrics is not None and isinstance(entry, CompressedMPI)

@@ -182,6 +182,7 @@ class FakeEngine(RenderEngine):
     def predict(
         self, image: np.ndarray, spec=None, request_id: str | None = None,
         weights: WeightSet | None = None,
+        tier: str | None = None, prune_eps: float | None = None,
     ) -> MPIEntry | CompressedMPI:
         chaos.maybe_raise("predict_raise")  # same seam as the real engine
         ws = weights if weights is not None else self._weights
@@ -191,8 +192,11 @@ class FakeEngine(RenderEngine):
         )
         # the REAL compression path (tier + transmittance pruning) over the
         # fake slabs — compression-ratio/pruning behavior is exercised
-        # compile-free, and _adopt_entry keeps everything host numpy
-        entry = self._compress(bucket, mpi_rgb, mpi_sigma, disparity)
+        # compile-free, and _adopt_entry keeps everything host numpy; the
+        # explicit tier/prune_eps snapshot overrides flow through exactly
+        # like the real engine's (serving/degrade.py L1)
+        entry = self._compress(bucket, mpi_rgb, mpi_sigma, disparity,
+                               tier=tier, prune_eps=prune_eps)
         if self.metrics is not None:
             self.metrics.encoder_invocations.inc()
         return entry
@@ -205,7 +209,15 @@ class FakeEngine(RenderEngine):
         if poses.ndim != 3 or poses.shape[1:] != (4, 4):
             raise ValueError(f"poses must be (N, 4, 4), got {poses.shape}")
         if self.render_delay_s:
-            time.sleep(self.render_delay_s)
+            # the real engine's cost model in miniature: a pruned entry
+            # runs a smaller plane-count executable, so its dispatch is
+            # proportionally cheaper — which is what makes the brownout
+            # ladder's L1 (int8 + pruning) an actual capacity lever in
+            # fake-fleet overload scenarios, not just a byte saving
+            delay = self.render_delay_s
+            if isinstance(entry, CompressedMPI) and entry.num_planes_full:
+                delay *= entry.planes_kept / entry.num_planes_full
+            time.sleep(delay)
         n = poses.shape[0]
         h, w, _ = entry.bucket
         # the real engine's executable-selection arithmetic, against the

@@ -176,6 +176,10 @@ class Replica:
         self.gate = HealthGate(up_after=up_after, down_after=down_after)
         self.not_before = 0.0  # Retry-After cooldown (router clock)
         self.last_probe: dict | None = None
+        # last X-Degraded level this replica announced (0 = full fidelity;
+        # serving/degrade.py) — refreshed on every answered forward, fed
+        # into the fleet-wide mine_fleet_degradation_level gauge
+        self.degraded_level = 0
 
 
 def _urllib_transport(
@@ -274,6 +278,11 @@ class FleetMetrics:
         self.autoscale_target = r.gauge(
             "mine_fleet_autoscale_target_replicas",
             "the autoscale controller's current desired replica count",
+        )
+        self.degradation_level = r.gauge(
+            "mine_fleet_degradation_level",
+            "worst brownout-ladder level any ring replica last announced "
+            "via X-Degraded (serving/degrade.py; 0 = full fidelity)",
         )
 
     def render(self) -> str:
@@ -408,6 +417,17 @@ class FleetApp:
                     to="up" if replica.gate.healthy else "down",
                 )
 
+    def _republish_degradation(self) -> None:
+        """Fleet-wide brownout visibility: the worst ladder level any
+        replica last announced — via X-Degraded on a forwarded response
+        or its /healthz degradation snapshot — is the autoscaler's
+        scale-up signal."""
+        with self._lock:
+            self.metrics.degradation_level.set(max(
+                (r.degraded_level for r in self.replicas.values()),
+                default=0,
+            ))
+
     def probe_once(self) -> dict[str, bool]:
         """One /healthz sweep over every replica (in or out of the ring —
         ejected replicas must keep being probed to ever rejoin)."""
@@ -424,6 +444,16 @@ class FleetApp:
                     replica.last_probe.update(json.loads(body))
                 except ValueError:
                     pass
+                else:
+                    # an idle replica announces recovery through its
+                    # /healthz degradation snapshot — without this, the
+                    # level last seen on a forwarded response would stay
+                    # stale (and hold the fleet gauge up) until the next
+                    # product request happened to land there
+                    deg = replica.last_probe.get("degradation")
+                    if isinstance(deg, dict):
+                        replica.degraded_level = int(deg.get("level") or 0)
+                        self._republish_degradation()
             except Exception as exc:  # noqa: BLE001 - a probe may die anyhow
                 ok = False
                 replica.last_probe = {"error": f"{type(exc).__name__}: {exc}"}
@@ -570,6 +600,12 @@ class FleetApp:
             # hundreds of successes in between cannot eject the replica
             # (the hysteresis contract is about consecutive signal)
             self._observe(replica, True)
+            # fleet-wide brownout visibility: every answered forward
+            # refreshes the replica's announced ladder level (absence of
+            # X-Degraded IS the L0 announcement) and republishes the worst
+            # level across the fleet — the autoscaler's scale-up signal
+            replica.degraded_level = _parse_degraded_level(resp_headers)
+            self._republish_degradation()
             return status, resp_headers, resp_body, replica.name
         if self.clock() >= deadline:
             raise FleetDeadlineExceeded(
@@ -685,6 +721,22 @@ def _parse_retry_after(headers: dict[str, str]) -> float:
             except ValueError:
                 break
     return 1.0
+
+
+def _parse_degraded_level(headers: dict[str, str]) -> int:
+    """The ladder level out of an `X-Degraded: level=<n>;tier=<t>` header
+    (serving/degrade.py announcement); 0 when absent or malformed — a
+    replica that says nothing is serving at full fidelity."""
+    for key, value in headers.items():
+        if key.lower() == "x-degraded":
+            for part in value.split(";"):
+                name, _, val = part.strip().partition("=")
+                if name == "level":
+                    try:
+                        return max(0, int(val))
+                    except ValueError:
+                        return 0
+    return 0
 
 
 def digest_of_request(path: str, body: bytes,
@@ -850,7 +902,10 @@ class _FleetHandler(BaseHTTPRequestHandler):
             return 504
         extra = {"X-Mine-Replica": replica}
         for k, v in resp_headers.items():
-            if k.lower() in ("retry-after", "x-request-id"):
+            # X-Degraded passes through untouched: a client of the ROUTER
+            # still learns its answer was served degraded (and at what
+            # level/tier) exactly as a direct-replica client would
+            if k.lower() in ("retry-after", "x-request-id", "x-degraded"):
                 extra[k] = v
         self._send(status, resp_body,
                    resp_headers.get("Content-Type", "application/json"),

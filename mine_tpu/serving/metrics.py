@@ -97,6 +97,22 @@ class ServingMetrics:
             "mine_serve_breaker_state",
             "circuit breaker state: 0 closed, 1 half-open, 2 open",
         )
+
+        # brownout degradation ladder (serving/degrade.py): fidelity
+        # traded for availability BEFORE any shed. Degraded responses are
+        # SLO-visible but 5xx-exempt — they are successes, served cheaper.
+        self.degradation_level = r.gauge(
+            "mine_serve_degradation_level",
+            "brownout ladder level: 0 normal, 1 int8+pruned predicts, "
+            "2 stale-while-revalidate, 3 widened coalescing (the 503 "
+            "shed only fires past 3)",
+        )
+        self.degradation_responses = r.counter(
+            "mine_serve_degradation_responses_total",
+            "product responses served while the brownout ladder was "
+            "engaged, by level — every one also carried an X-Degraded "
+            "header announcing its level and effective tier",
+        )
         self.breaker_trips = r.counter(
             "mine_serve_breaker_trips_total",
             "closed/half-open -> open transitions after consecutive engine "

@@ -51,6 +51,16 @@ failures, shedding immediately (503) and reporting /healthz as degraded
 (HTTP 503) until a half-open trial succeeds. Overload is always an honest
 503/504 — never a hang, never a 500.
 
+Brownout (serving/degrade.py, `serving.degrade_enabled`): BEFORE any of
+those sheds fire, a per-replica degradation ladder trades fidelity for
+availability — int8 + pruned predicts (L1), stale-while-revalidate over
+older-generation cache entries with the peer-fetch hop skipped (L2), a
+widened coalescing window (L3) — and only past L3 does the existing 503
+shed engage. Every degraded product answer announces itself with an
+`X-Degraded: level=<n>;tier=<t>` header and ticks
+mine_serve_degradation_responses_total{level=}; degraded 200s are
+SLO-visible but never 5xx.
+
 CLI: python -m mine_tpu.serving.server --workspace <train workspace>
 restores params only (training/checkpoint.py load_for_serving), pre-warms
 the default bucket's executables, and serves until killed.
@@ -62,6 +72,7 @@ import argparse
 import base64
 import hashlib
 import io
+import itertools
 import json
 import os
 import threading
@@ -94,6 +105,7 @@ from mine_tpu.serving.batcher import (
 )
 from mine_tpu.serving.cache import MPICache, key_from_str, key_to_str, mpi_key
 from mine_tpu.serving.compress import CompressedMPI, from_wire, to_wire
+from mine_tpu.serving.degrade import PressureSample, controller_from_config
 from mine_tpu.serving.fleet import DEFAULT_VNODES
 from mine_tpu.serving.engine import (
     BucketSpec,
@@ -108,6 +120,12 @@ from mine_tpu.serving.metrics import ServingMetrics
 class RequestTimeout(RuntimeError):
     """The handler thread's wait on its future timed out; the pending
     request (if still queued) was evicted. Maps to HTTP 504."""
+
+
+# distinct default breaker-jitter seeds for apps built in one process (a
+# bench/drill fleet): replicas that tripped together must not re-probe in
+# lockstep (resilience/breaker.py reset_jitter)
+_APP_SEQ = itertools.count(1)
 
 
 def _decode_image(data: bytes) -> np.ndarray:
@@ -177,6 +195,7 @@ class ServingApp:
         peers: dict[str, str] | None = None,
         peer_name: str | None = None,
         peer_fetch_timeout_s: float | None = None,
+        breaker_jitter_seed: int | None = None,
     ):
         res = cfg.resilience  # ctor args override the resilience.* knobs
 
@@ -192,6 +211,11 @@ class ServingApp:
                 breaker_failure_threshold, res.breaker_failure_threshold
             ),
             reset_after_s=knob(breaker_reset_s, res.breaker_reset_s),
+            # de-synchronized half-open probes: fleet replicas that tripped
+            # on one shared backend fault re-probe at distinct instants
+            reset_jitter=res.breaker_reset_jitter,
+            jitter_seed=(next(_APP_SEQ) if breaker_jitter_seed is None
+                         else breaker_jitter_seed),
             on_state=self.metrics.breaker_state.set,
             on_trip=self.metrics.breaker_trips.inc,
         )
@@ -303,12 +327,73 @@ class ServingApp:
         ).start()
         self.request_timeout_s = request_timeout_s
         self._started_at = time.time()
+        # brownout ladder (serving/degrade.py): load-adaptive degradation
+        # engaged BEFORE any 503 shed. Disabled by default — overload tests
+        # and operators that want shed-only behavior keep the old contract;
+        # the bench/drill fleets and production turn it on via config.
+        self._last_burn = 0.0  # worst mine_slo_burn_rate at last scrape
+        self._normal_delay_s = self.batcher.max_delay_s
+        self._degraded_delay_s = cfg.serving.degrade_coalesce_delay_ms / 1e3
+        self.degrade = (
+            controller_from_config(cfg, on_level=self._apply_degradation)
+            if cfg.serving.degrade_enabled else None
+        )
+        self.metrics.degradation_level.set(0)
         # predict singleflight: concurrent misses for one key share one
         # encoder pass (the batcher's coalescing idea applied to the
         # expensive half — without it, N simultaneous uploads of one image
         # run N encoder passes and materialize N ~100 MB MPIs)
         self._inflight: dict[Any, Future] = {}
         self._inflight_lock = threading.Lock()
+
+    # -- brownout ladder (serving/degrade.py) ----------------------------------
+
+    def _degrade_tick(self) -> int:
+        """One ladder observation: gather the live pressure sample (queue
+        depth and breaker state are read live; the burn rate is the worst
+        one the SLO tracker published at the last scrape), advance the
+        state machine, return the level. Called per product request and
+        per /metrics scrape — an idle replica still relaxes on the
+        autoscaler's scrape cadence. No-op (level 0) when disabled."""
+        if self.degrade is None:
+            return 0
+        return self.degrade.tick(PressureSample(
+            queue_frac=self.batcher.queue_frac(),
+            burn_rate=self._last_burn,
+            breaker_open=self.breaker.state == "open",
+        ))
+
+    def _apply_degradation(self, level: int) -> None:
+        """Apply one level's semantics to the live components — the
+        controller's on_level hook, fired only on transitions (so the
+        batcher's condition is not re-notified per request). L1's
+        compression override routes through the engine, where the predict
+        path snapshots it once per request (key and entry always agree);
+        L3 widens — and any lower level restores — the batcher's
+        coalescing window for the CURRENT queue, not just future work."""
+        tier = self.degrade.tier_override()
+        if tier is not None:
+            self.engine.set_degraded_compression(
+                tier, self.degrade.prune_eps_override()
+            )
+        else:
+            self.engine.clear_degraded_compression()
+        self.batcher.set_max_delay_s(
+            self._degraded_delay_s if self.degrade.widen_coalesce()
+            else self._normal_delay_s
+        )
+        self.metrics.degradation_level.set(level)
+
+    def slo_scrape(self) -> None:
+        """Scrape-cadence SLO refresh (obs/slo.py) + one ladder
+        observation: the burn rates the tracker just published become the
+        ladder's burn signal until the next scrape."""
+        report = self.slo.evaluate()
+        self._last_burn = max(
+            (row.get("burn_rate", 0.0) for row in report.values()),
+            default=0.0,
+        )
+        self._degrade_tick()
 
     # -- circuit breaker around the engine ------------------------------------
 
@@ -383,6 +468,19 @@ class ServingApp:
         corrupt-checkpoint chaos seam fires here (a ChaosFault stands in
         for orbax choking on a truncated/corrupt file)."""
         chaos.maybe_raise("corrupt_swap")  # fault seam (resilience/chaos.py)
+        if chaos.should("corrupt_ckpt"):
+            # integrity-specific corruption: a checkpoint whose BYTES no
+            # longer match the sha256-of-manifest sidecar written at save
+            # time — the named rejection verify_checkpoint_integrity
+            # raises on a real workspace, injected here so the fake-fleet
+            # drill proves the swap is refused and the old generation
+            # keeps serving (reason="corrupt", never a 5xx)
+            from mine_tpu.training.checkpoint import CheckpointCorrupt
+
+            raise CheckpointCorrupt(
+                "chaos-injected corrupt checkpoint",
+                ["manifest sha256 mismatch (chaos seam)"],
+            )
         if callable(self.swap_source):
             return self.swap_source()
         from mine_tpu.training.checkpoint import load_for_serving
@@ -420,8 +518,17 @@ class ServingApp:
             }
 
     def _swap_attempt(self, target_step: int | None) -> dict[str, Any]:
+        from mine_tpu.training.checkpoint import CheckpointCorrupt
+
         try:
             params, batch_stats, step = self._load_swap_source(target_step)
+        except CheckpointCorrupt as exc:
+            # integrity-rejected BEFORE generic load failures: the sidecar
+            # mismatch has its own reason so an operator can tell "the
+            # bytes rotted" from "orbax could not restore"
+            self.metrics.swap_failures.inc(reason="corrupt")
+            return {"state": "failed", "reason": "corrupt",
+                    "error": f"{type(exc).__name__}: {exc}"}
         except Exception as exc:  # noqa: BLE001 - named, counted, no 5xx
             self.metrics.swap_failures.inc(reason="load")
             return {"state": "failed", "reason": "load",
@@ -510,23 +617,30 @@ class ServingApp:
                     "(extend with --bucket H,W,S at server start)"
                 )
         bucket = self.engine.bucket(spec)  # validates the requested shape
+        self._degrade_tick()  # ladder observation BEFORE the operating
+        # point is snapshotted: this request serves at the level it ticked
         # ONE weights snapshot keys the cache AND runs the dispatch: reading
         # checkpoint_step and variables separately could straddle a hot swap
-        # and file a new-generation MPI under the old generation's key
+        # and file a new-generation MPI under the old generation's key. The
+        # compression operating point obeys the SAME discipline: tier and
+        # prune_eps are read ONCE here and passed into the engine dispatch
+        # explicitly, so a brownout level flip mid-request can never file
+        # an int8 entry under an fp32 key (or vice versa).
         weights = self.engine.weights()
-        key = mpi_key(digest, weights.checkpoint_step, bucket.spec,
-                      self.engine.cache_tier)
+        tier = self.engine.effective_tier()
+        prune_eps = self.engine.effective_prune_eps()
+        key = mpi_key(digest, weights.checkpoint_step, bucket.spec, tier)
 
-        def response(entry, cached: bool) -> dict:
+        def response(entry, cached: bool, entry_key=None) -> dict:
             return {
-                "mpi_key": key_to_str(key),
+                "mpi_key": key_to_str(key if entry_key is None else entry_key),
                 "cached": cached,
                 "bucket": list(bucket.spec),
                 "planes": bucket.num_planes,
                 "planes_kept": (entry.planes_kept
                                 if isinstance(entry, CompressedMPI)
                                 else bucket.num_planes),
-                "tier": self.engine.cache_tier,
+                "tier": key[5] if entry_key is None else entry_key[5],
                 "mpi_bytes": entry.nbytes,
             }
 
@@ -535,6 +649,19 @@ class ServingApp:
             entry = self.cache.get(key)
         if entry is not None:
             return response(entry, cached=True)
+        if self.degrade is not None and self.degrade.serve_stale():
+            # L2 stale-while-revalidate: the newest OLDER-step resident
+            # entry for this scene answers the miss — post-swap, the old
+            # generation's mpi_keys keep serving instead of forcing a
+            # re-predict per scene while the replica is under pressure.
+            # The response carries the STALE key so follow-up renders hit.
+            stale = self.cache.stale_key(key)
+            if stale is not None:
+                old = self.cache.get(stale, record=False)
+                if old is not None:
+                    out = response(old, cached=True, entry_key=stale)
+                    out["stale"] = True
+                    return out
         with self._inflight_lock:
             future = self._inflight.get(key)
             owner = future is None
@@ -579,13 +706,17 @@ class ServingApp:
                 )
             # then the fleet wire: a peer holding this exact key hands us
             # the compressed MPI for network bytes instead of encoder FLOPs
-            entry = self._peer_fetch(key, digest, request_id=request_id,
-                                     parent_span=parent_span)
+            # — unless the ladder is at L2+, where the wire round-trip is
+            # latency spent on fidelity nobody can afford right now
+            entry = None
+            if self.degrade is None or not self.degrade.skip_peer_fetch():
+                entry = self._peer_fetch(key, digest, request_id=request_id,
+                                         parent_span=parent_span)
             from_peer = entry is not None
             if entry is None:
                 entry = self._breaker_guard(
                     "predict", self.engine.predict, image, bucket.spec,
-                    request_id, weights,
+                    request_id, weights, tier, prune_eps,
                 )
             self.cache.put(key, entry)
             future.set_result(entry)
@@ -823,6 +954,8 @@ class ServingApp:
         request_id: str | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         key = key_from_str(key_str)
+        self._degrade_tick()  # renders feel queue pressure first: the
+        # ladder's L3 (widened coalescing) acts on exactly this path
         with self.tracer.span("cache_lookup", cat="serve", endpoint="render",
                               request_id=request_id):
             entry = self.cache.get(key)
@@ -904,6 +1037,8 @@ class ServingApp:
             "queue_bound": self.batcher.max_queue_requests,
             "breaker": breaker_state,
             "breaker_trips": self.breaker.trips,
+            "degradation": (None if self.degrade is None
+                            else self.degrade.snapshot()),
             "trace_enabled": self.tracer.enabled,
             "trace_spans_buffered": len(self.tracer),
         }
@@ -935,10 +1070,42 @@ class _Handler(BaseHTTPRequestHandler):
         if self.server.verbose:
             super().log_message(fmt, *args)
 
+    def _observe(self, code: int) -> None:
+        """Count + time this request EXACTLY once, BEFORE its response
+        bytes hit the socket: a client that saw its answer and immediately
+        scrapes /metrics must find the request already counted. The old
+        order (observe after wfile.write, at the end of _handle) left a
+        window where the response existed but the counters had not — which
+        tests/test_obs.py could only paper over by polling the scrape."""
+        if getattr(self, "_observed", True) or not hasattr(self, "_t0"):
+            return
+        self._observed = True
+        app = self.server.app
+        app.metrics.requests.inc(endpoint=self._endpoint, status=str(code))
+        app.metrics.request_latency.observe(
+            time.monotonic() - self._t0, endpoint=self._endpoint
+        )
+
+    def _degraded_headers(self, app: ServingApp) -> dict[str, str] | None:
+        """The X-Degraded announcement for a product answer served while
+        the brownout ladder is engaged (serving/degrade.py): every
+        degraded 200 names its level and effective tier and ticks the
+        per-level response counter — degradation is always announced,
+        never silent. None (no header) at L0 or with the ladder off."""
+        degrade = app.degrade
+        if degrade is None or degrade.level <= 0:
+            return None
+        degrade.record_response()
+        app.metrics.degradation_responses.inc(level=str(degrade.level))
+        return {
+            "X-Degraded": degrade.announcement(app.engine.effective_tier()),
+        }
+
     def _send(
         self, code: int, payload: bytes, content_type: str,
         extra_headers: dict[str, str] | None = None,
     ) -> None:
+        self._observe(code)
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
@@ -1000,8 +1167,13 @@ class _Handler(BaseHTTPRequestHandler):
         return None
 
     def _route(self, method: str, path: str) -> tuple[int, str]:
+        # each branch stashes its endpoint label BEFORE dispatching, so
+        # _observe (which fires inside _send, before the response bytes)
+        # labels the requests/latency families with the same endpoint
+        # names the families have always carried
         app = self.server.app
         if method == "GET" and path == "/healthz":
+            self._endpoint = "healthz"
             health = app.health()
             # degraded (breaker OPEN) and draining answer 503 so load
             # balancers/probes drain this replica; "recovering" (half-open)
@@ -1012,15 +1184,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(code, health)
             return code, "healthz"
         if method == "GET" and path == "/metrics":
+            self._endpoint = "metrics"
             # scrape-cadence HBM sample: the gauges stay current even when
             # no dispatch has run since the last scrape (obs/memlog.py);
-            # the SLO gauges refresh on the same cadence (obs/slo.py)
+            # the SLO gauges refresh on the same cadence (obs/slo.py), and
+            # the brownout ladder gets an observation too — an IDLE
+            # overloaded-then-recovered replica relaxes on scrape cadence
+            # instead of waiting for its next product request
             app.memlog.sample()
-            app.slo.evaluate()
+            app.slo_scrape()
             self._send(200, app.metrics.render().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
             return 200, "metrics"
         if method == "GET" and path == "/debug/trace":
+            self._endpoint = "debug_trace"
             query = parse_qs(self.path.partition("?")[2])
             rid = (query.get("request_id") or [None])[0]
             if rid:
@@ -1031,6 +1208,7 @@ class _Handler(BaseHTTPRequestHandler):
                 ))
             return 200, "debug_trace"
         if method == "POST" and path in ("/predict", "/render"):
+            self._endpoint = path.lstrip("/")
             if app.draining:
                 # drain shedding: product traffic bounces with the same
                 # 503 + Retry-After contract as overload — the router's
@@ -1049,6 +1227,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._predict(app), "predict"
             return self._render(app), "render"
         if method == "GET" and path.startswith("/mpi/"):
+            self._endpoint = "mpi"
             # the fleet wire: the compressed container for one cache key,
             # served to peer replicas (serving/compress.py to_wire)
             key_str = path[len("/mpi/"):]
@@ -1065,11 +1244,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, blob, "application/octet-stream")
             return 200, "mpi"
         if method == "GET" and path == "/admin/swap":
+            self._endpoint = "admin_swap"
             self._send_json(200, app.swap_status())
             return 200, "admin_swap"
         if method == "POST" and path == "/admin/swap":
+            self._endpoint = "admin_swap"
             return self._admin_swap(app), "admin_swap"
         if method == "GET" and path == "/debug/hot_keys":
+            self._endpoint = "debug_hot_keys"
             # the hot-key surface (MPICache.hot_keys): what a joining
             # replica pre-warms and what an operator reads to see the arc
             query = parse_qs(self.path.partition("?")[2])
@@ -1084,11 +1266,15 @@ class _Handler(BaseHTTPRequestHandler):
             ]})
             return 200, "debug_hot_keys"
         if method == "POST" and path == "/admin/drain":
+            self._endpoint = "admin_drain"
             return self._admin_drain(app), "admin_drain"
         if method == "POST" and path == "/admin/peers":
+            self._endpoint = "admin_peers"
             return self._admin_peers(app), "admin_peers"
         if method == "POST" and path == "/admin/prewarm":
+            self._endpoint = "admin_prewarm"
             return self._admin_prewarm(app), "admin_prewarm"
+        self._endpoint = "unknown"
         self._send_json(404, {"error": f"no route {method} {path}"})
         return 404, "unknown"
 
@@ -1176,6 +1362,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._parent_span = resolve_parent_span(
             self.headers.get(PARENT_SPAN_HEADER)
         )
+        if chaos.should("overload_spike") and app.degrade is not None:
+            # synthetic pressure spike (resilience/chaos.py): the ladder's
+            # next observations classify as breach regardless of the real
+            # signals — the drill's deterministic full climb + descent
+            app.degrade.inject()
         if chaos.should("replica_kill"):  # fault seam (resilience/chaos.py)
             # replica death, as a fleet router sees it: the listener goes
             # away and the triggering connection drops with NO response —
@@ -1193,7 +1384,12 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
             return
-        t0 = time.monotonic()
+        # request accounting state for _observe: the endpoint label is
+        # stashed per-branch by _route; the observation itself fires inside
+        # _send, BEFORE the response bytes are written (tests/test_obs.py)
+        self._t0 = time.monotonic()
+        self._observed = False
+        self._endpoint = path.lstrip("/") or "unknown"
         p0 = time.perf_counter()
         try:
             code, endpoint = self._route(method, path)
@@ -1202,12 +1398,14 @@ class _Handler(BaseHTTPRequestHandler):
         except _BodyTooLarge as exc:
             # refuse WITHOUT reading: the oversized body is never buffered
             code, endpoint = 413, path.lstrip("/") or "unknown"
+            self._endpoint = endpoint
             try:
                 self._send_json(413, {"error": str(exc)})
             except Exception:  # noqa: BLE001 - client already gone
                 pass
         except Exception as exc:  # noqa: BLE001 - HTTP boundary
             code, endpoint = 500, path.lstrip("/") or "unknown"
+            self._endpoint = endpoint
             try:
                 self._send_json(500, {"error": f"{type(exc).__name__}: {exc}"})
             except Exception:  # noqa: BLE001 - client already gone
@@ -1223,10 +1421,9 @@ class _Handler(BaseHTTPRequestHandler):
                 status=code, span_id=self._span_id,
                 parent_span=self._parent_span,
             )
-        app.metrics.requests.inc(endpoint=endpoint, status=str(code))
-        app.metrics.request_latency.observe(
-            time.monotonic() - t0, endpoint=endpoint
-        )
+        # backstop for a response the client never received (its socket
+        # died before _send could run): the request still happened
+        self._observe(code)
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         self._handle("GET")
@@ -1268,7 +1465,7 @@ class _Handler(BaseHTTPRequestHandler):
             # PIL's UnidentifiedImageError subclasses OSError, not ValueError
             self._send_json(400, {"error": str(exc)})
             return 400
-        self._send_json(200, result)
+        self._send_json(200, result, self._degraded_headers(app))
         return 200
 
     def _admin_swap(self, app: ServingApp) -> int:
@@ -1351,7 +1548,7 @@ class _Handler(BaseHTTPRequestHandler):
                     base64.b64encode(_encode_png(f)).decode()
                     for f in to_uint8(normalize_disparity(disp))[..., 0]
                 ]
-        self._send_json(200, out)
+        self._send_json(200, out, self._degraded_headers(app))
         return 200
 
 

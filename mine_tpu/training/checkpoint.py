@@ -123,12 +123,23 @@ def save(manager: ocp.CheckpointManager, state: Any, step: int) -> None:
     """Write one gathered (host-array) checkpoint. Multi-process: process
     0 writes alone — the state is replicated host data on every process
     (see checkpoint_manager); peers return immediately and rely on the
-    atomic commit for read-side consistency."""
+    atomic commit for read-side consistency.
+
+    After the commit an integrity sidecar (sha256-of-manifest, below) is
+    recorded so the serving-side swap path can verify the bytes it is
+    about to promote instead of deferring to an opaque restore error."""
     import jax
 
     if jax.process_index() != 0:
         return
     manager.save(step, args=ocp.args.StandardSave(state))
+    path = str(manager.directory)
+    if "://" not in path:
+        # the sidecar hashes committed files, so the async save must land
+        # first; remote (gs://) checkpoint trees cannot be walked with
+        # plain os IO and rely on the object store's own integrity
+        manager.wait_until_finished()
+        write_integrity_sidecar(os.path.dirname(path), step)
 
 
 def restore(manager: ocp.CheckpointManager, state_template: Any) -> tuple[Any, int]:
@@ -266,6 +277,133 @@ def restore_last_good(
     return state, step
 
 
+# -- integrity sidecar (sha256-of-manifest; serving swap verification) --------
+#
+# Orbax's atomic rename guarantees a step directory is either absent or
+# complete AT COMMIT TIME; it says nothing about the bytes afterwards
+# (bit rot, a partial copy between machines, an overzealous cleanup job).
+# Before this sidecar a corrupted checkpoint surfaced as whatever opaque
+# error the restore happened to hit — or worse, restored plausibly. Now
+# every local save records a manifest (relative path -> size + sha256 of
+# every file under the step directory) plus the sha256 of that manifest,
+# and the serving swap path re-hashes before promoting: a divergence is
+# the NAMED CheckpointCorrupt (counter reason=corrupt), the old
+# generation keeps serving. Pre-sidecar checkpoints verify vacuously —
+# absence of the sidecar is legacy, not corruption.
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint's bytes no longer match the sha256-of-manifest
+    sidecar recorded at save time. The NAMED corrupt-rejection error: the
+    hot-swap path surfaces it as swap_failures{reason=corrupt} and keeps
+    the old generation serving (serving/server.py _swap_attempt)."""
+
+    def __init__(self, context: str, problems: list[str]):
+        self.problems = problems
+        shown = "; ".join(problems[:5])
+        more = f" (+{len(problems) - 5} more)" if len(problems) > 5 else ""
+        super().__init__(f"{context}: {shown}{more}")
+
+
+def _integrity_path(workspace: str, step: int) -> str:
+    # plain-file IO -> sidecar mapping, like last_good.json
+    return os.path.join(
+        local_sidecar_dir(workspace), "integrity", f"{int(step)}.json"
+    )
+
+
+def _step_manifest(workspace: str, step: int) -> dict[str, dict]:
+    """relative path -> {"bytes": n, "sha256": hex} for every file under
+    the committed step directory, sorted-walk deterministic."""
+    root = os.path.join(checkpoint_path(workspace), str(int(step)))
+    if not os.path.isdir(root):
+        raise CheckpointCorrupt(
+            f"checkpoint step {step} under {workspace}",
+            [f"step directory missing: {root}"],
+        )
+    manifest: dict[str, dict] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            digest = hashlib.sha256()
+            with open(full, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    digest.update(chunk)
+            manifest[os.path.relpath(full, root)] = {
+                "bytes": os.path.getsize(full),
+                "sha256": digest.hexdigest(),
+            }
+    return manifest
+
+
+def _manifest_sha256(manifest: dict[str, dict]) -> str:
+    return hashlib.sha256(
+        json.dumps(manifest, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def write_integrity_sidecar(workspace: str, step: int) -> None:
+    """Record the step's manifest + its sha256. Same atomic-rename
+    discipline as mark_last_good: a crash mid-write leaves old-or-new,
+    never a half-written sidecar that would condemn a healthy step."""
+    manifest = _step_manifest(workspace, step)
+    path = _integrity_path(workspace, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({
+            "step": int(step),
+            "manifest_sha256": _manifest_sha256(manifest),
+            "files": manifest,
+        }, fh, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def verify_checkpoint_integrity(workspace: str, step: int) -> None:
+    """Re-hash the step directory against its recorded sidecar; raise
+    CheckpointCorrupt naming the first diverging files on mismatch.
+
+    No sidecar (pre-sidecar checkpoint) or a remote (URL-scheme)
+    workspace verifies vacuously — absence is legacy, and remote trees
+    cannot be walked with plain os IO (the object store carries its own
+    integrity)."""
+    if "://" in workspace:
+        return
+    try:
+        with open(_integrity_path(workspace, step)) as fh:
+            recorded = json.load(fh)
+    except OSError:
+        return  # legacy checkpoint: saved before the sidecar existed
+    except ValueError as exc:
+        raise CheckpointCorrupt(
+            f"checkpoint step {step} under {workspace}",
+            [f"unreadable integrity sidecar: {exc}"],
+        ) from None
+    actual = _step_manifest(workspace, step)
+    want = recorded.get("files", {})
+    problems: list[str] = []
+    for name in sorted(set(want) - set(actual)):
+        problems.append(f"missing file {name}")
+    for name in sorted(set(actual) - set(want)):
+        problems.append(f"unexpected file {name}")
+    for name in sorted(set(want) & set(actual)):
+        if want[name] != actual[name]:
+            problems.append(
+                f"file {name}: recorded {want[name]['bytes']}B "
+                f"sha256 {want[name]['sha256'][:12]}…, found "
+                f"{actual[name]['bytes']}B "
+                f"sha256 {actual[name]['sha256'][:12]}…"
+            )
+    if not problems and recorded.get("manifest_sha256") != \
+            _manifest_sha256(actual):
+        problems.append("manifest sha256 mismatch")
+    if problems:
+        raise CheckpointCorrupt(
+            f"checkpoint step {step} under {workspace}", problems
+        )
+
+
 class CheckpointTreeMismatch(ValueError):
     """A restored checkpoint's param tree does not match the structure/
     shapes the consumer expects. The NAMED swap-rejection error: before
@@ -386,6 +524,10 @@ def load_for_serving(
             True,
         )
         return cfg, variables["params"], variables.get("batch_stats", {}), 0
+    # the promotion/swap fence: bytes must still match their save-time
+    # sidecar BEFORE any of them are parsed — a mismatch is the named
+    # CheckpointCorrupt here, never an opaque restore error downstream
+    verify_checkpoint_integrity(workspace, step)
     # template-free restore: a raw pytree of host arrays (the explicit
     # StandardRestore arg matters — a fresh manager has no handler registered
     # for the saved item and a bare restore(step) raises)

@@ -1,0 +1,581 @@
+"""Brownout ladder tests: the DegradationController state machine on a
+fake clock, stale-while-revalidate cache lookups, the end-to-end
+announce/metric surfaces over a FakeEngine app (live HTTP, zero XLA
+compiles), the autoscale coupling, breaker half-open jitter, checkpoint
+integrity sidecars, and the README ladder-table drift check (the
+test_metrics_docs idiom)."""
+
+import io
+import json
+import pathlib
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mine_tpu.config import Config
+from mine_tpu.resilience import chaos
+from mine_tpu.serving.cache import mpi_key
+from mine_tpu.serving.degrade import (
+    LADDER,
+    MAX_LEVEL,
+    DegradationController,
+    PressureSample,
+    controller_from_config,
+)
+from mine_tpu.serving.fake import fake_checkpoint, make_fake_app
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CALM = PressureSample(queue_frac=0.0, burn_rate=0.0)
+BREACH = PressureSample(queue_frac=1.0, burn_rate=9.0)
+DEADBAND = PressureSample(queue_frac=0.5, burn_rate=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _png(i: int = 0) -> bytes:
+    from PIL import Image
+
+    img = np.full((8, 8, 3), (i * 53) % 256, np.uint8)
+    img[0, 0] = (i % 256, 3, 9)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _ctl(**kw):
+    """Controller over a list-backed fake clock (the autoscale idiom)."""
+    clock = [0.0]
+    kw.setdefault("engage_after", 2)
+    kw.setdefault("relax_after", 3)
+    kw.setdefault("dwell_s", 10.0)
+    ctl = DegradationController(clock=lambda: clock[0], **kw)
+    return ctl, clock
+
+
+def _degrade_cfg(**over):
+    base = {
+        "data.img_h": 128, "data.img_w": 128, "mpi.num_bins_coarse": 2,
+        "serving.degrade_enabled": True,
+        "serving.degrade_engage_after": 1,
+        # relaxing must not race the test's assertions: the /metrics
+        # scrapes interleaved below each tick a calm sample, so relax
+        # slowly and dwell long while degraded behavior is asserted
+        "serving.degrade_relax_after": 5,
+        "serving.degrade_dwell_s": 300.0,
+    }
+    base.update(over)
+    return Config().replace(**base)
+
+
+# ------------------------------------------------------ the state machine
+
+
+def test_controller_validates_knobs():
+    with pytest.raises(ValueError):
+        DegradationController(queue_low=0.9, queue_high=0.5)
+    with pytest.raises(ValueError):
+        DegradationController(burn_low=3.0, burn_high=1.0)
+    with pytest.raises(ValueError):
+        DegradationController(engage_after=0)
+    with pytest.raises(ValueError):
+        DegradationController(dwell_s=-1.0)
+    with pytest.raises(ValueError):
+        DegradationController(max_level=MAX_LEVEL + 1)
+
+
+def test_escalation_needs_consecutive_breaches():
+    ctl, _clock = _ctl(engage_after=2)
+    assert ctl.tick(BREACH) == 0  # breach 1 of 2
+    assert ctl.tick(CALM) == 0    # streak reset
+    assert ctl.tick(BREACH) == 0
+    assert ctl.tick(BREACH) == 1  # 2 consecutive: one level up
+
+
+def test_deadband_holds_and_resets_both_streaks():
+    ctl, clock = _ctl(engage_after=2, relax_after=2, dwell_s=0.0)
+    ctl.tick(BREACH)
+    assert ctl.tick(DEADBAND) == 0  # breach streak gone
+    ctl.tick(BREACH)
+    assert ctl.tick(BREACH) == 1
+    clock[0] = 100.0
+    ctl.tick(CALM)
+    assert ctl.tick(DEADBAND) == 1  # calm streak gone: still level 1
+    ctl.tick(CALM)
+    assert ctl.tick(CALM) == 0
+
+
+def test_breaker_open_is_a_breach_whatever_else_says():
+    ctl, _clock = _ctl(engage_after=1)
+    assert ctl.tick(PressureSample(breaker_open=True)) == 1
+
+
+def test_full_climb_and_descent_one_level_at_a_time():
+    ctl, clock = _ctl(engage_after=1, relax_after=2, dwell_s=5.0)
+    for want in (1, 2, 3):
+        assert ctl.tick(BREACH) == want
+    assert ctl.tick(BREACH) == 3  # clamped at max
+    # relax: 2 calm ticks AND 5s of residency per step down
+    for want in (2, 1, 0):
+        clock[0] += 6.0
+        ctl.tick(CALM)
+        assert ctl.tick(CALM) == want
+    assert ctl.tick(CALM) == 0  # clamped at 0
+    levels = [lvl for _, lvl in ctl.transitions()]
+    assert levels == [0, 1, 2, 3, 2, 1, 0]
+    assert all(abs(b - a) == 1 for a, b in zip(levels, levels[1:]))
+
+
+def test_dwell_blocks_relax_until_clock_advances():
+    ctl, clock = _ctl(engage_after=1, relax_after=1, dwell_s=30.0)
+    clock[0] = 100.0
+    assert ctl.tick(BREACH) == 1
+    clock[0] = 120.0
+    assert ctl.tick(CALM) == 1  # calm streak met, dwell not
+    clock[0] = 131.0
+    assert ctl.tick(CALM) == 0
+
+
+def test_max_level_caps_the_climb():
+    ctl, _clock = _ctl(engage_after=1, max_level=1)
+    assert ctl.tick(BREACH) == 1
+    assert ctl.tick(BREACH) == 1  # never past the configured cap
+
+
+def test_inject_walks_to_max_and_fires_on_level_per_transition():
+    seen = []
+    clock = [0.0]
+    ctl = DegradationController(
+        engage_after=2, relax_after=3, dwell_s=10.0,
+        clock=lambda: clock[0], on_level=seen.append,
+    )
+    ctl.inject()  # default: exactly enough breaches for the full climb
+    for _ in range(2 * MAX_LEVEL + 1):
+        ctl.tick(CALM)  # real signals calm: the injection overrides them
+    assert ctl.level == MAX_LEVEL
+    assert seen == [1, 2, 3]  # one callback per transition, in order
+
+
+def test_level_semantics_per_rung():
+    from mine_tpu.serving.compress import DEFAULT_PRUNE_EPS
+
+    ctl, _clock = _ctl(engage_after=1)
+    assert (ctl.tier_override(), ctl.prune_eps_override()) == (None, 0.0)
+    assert not ctl.serve_stale() and not ctl.skip_peer_fetch()
+    assert not ctl.widen_coalesce()
+    ctl.tick(BREACH)  # L1
+    assert ctl.tier_override() == "int8"
+    assert ctl.prune_eps_override() == DEFAULT_PRUNE_EPS
+    assert not ctl.serve_stale()
+    ctl.tick(BREACH)  # L2
+    assert ctl.serve_stale() and ctl.skip_peer_fetch()
+    assert not ctl.widen_coalesce()
+    ctl.tick(BREACH)  # L3
+    assert ctl.widen_coalesce()
+    assert ctl.announcement("int8") == "level=3;tier=int8"
+    snap = ctl.snapshot()
+    assert snap["level"] == 3 and snap["name"] == "coalesce"
+
+
+def test_controller_from_config_reads_the_knobs():
+    cfg = Config().replace(**{
+        "serving.degrade_engage_after": 7, "serving.degrade_dwell_s": 99.0,
+        "serving.degrade_max_level": 2,
+    })
+    ctl = controller_from_config(cfg)
+    assert ctl.engage_after == 7
+    assert ctl.dwell_s == 99.0
+    assert ctl.max_level == 2
+
+
+# ------------------------------------------------- stale-while-revalidate
+
+
+class _Blob:
+    def __init__(self, nbytes: int = 10):
+        self.nbytes = nbytes
+
+
+def test_stale_key_newest_older_step_same_scene_any_tier():
+    from mine_tpu.serving.cache import MPICache
+
+    cache = MPICache(byte_budget=1 << 20)
+    cache.put(mpi_key("d1", 1, (8, 8, 2)), _Blob())
+    cache.put(mpi_key("d1", 4, (8, 8, 2), tier="int8"), _Blob())
+    cache.put(mpi_key("d2", 4, (8, 8, 2)), _Blob())     # other scene
+    cache.put(mpi_key("d1", 4, (16, 16, 2)), _Blob())   # other bucket
+    fresh = mpi_key("d1", 7, (8, 8, 2))
+    # newest resident older step wins, across tiers
+    assert cache.stale_key(fresh) == mpi_key("d1", 4, (8, 8, 2), tier="int8")
+    # the fresh key's own step never counts as stale
+    assert cache.stale_key(mpi_key("d1", 1, (8, 8, 2))) is None
+    assert cache.stale_key(mpi_key("d3", 7, (8, 8, 2))) is None
+
+
+def test_swr_serves_old_generation_across_a_swap():
+    """L2's point: post-swap, a miss on the new generation's key answers
+    from the old generation's resident entry instead of re-predicting."""
+    app = make_fake_app(
+        checkpoint_step=1, cfg=_degrade_cfg(),
+        swap_source=lambda: fake_checkpoint(7),
+    )
+    try:
+        before = app.predict(_png(3))
+        assert before["mpi_key"].split(":")[1] == "1"
+        assert app.swap(wait=True)["state"] == "ok"
+        app.degrade.inject(2)
+        for _ in range(2):
+            app._degrade_tick()  # engage_after=1: two ticks -> L2
+        assert app.degrade.level == 2
+        out = app.predict(_png(3))
+        assert out["stale"] is True and out["cached"] is True
+        assert out["mpi_key"] == before["mpi_key"]  # the step-1 entry
+    finally:
+        app.close()
+
+
+# --------------------------------------- announce + metrics over live HTTP
+
+
+def test_http_flood_announces_every_degraded_answer():
+    app = make_fake_app(cfg=_degrade_cfg())
+    try:
+        from mine_tpu.serving.server import make_server
+
+        srv = make_server(app)
+        host, port = srv.server_address[:2]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            base = f"http://{host}:{port}"
+            app.degrade.inject()  # synthetic overload: 4 breach ticks
+            levels = []
+            for i in range(4):
+                req = urllib.request.Request(
+                    base + "/predict", data=_png(i),
+                    headers={"Content-Type": "image/png"},
+                )
+                resp = urllib.request.urlopen(req)
+                assert resp.status == 200  # degraded, never shed
+                header = resp.headers.get("X-Degraded")
+                assert header is not None  # every rung announces
+                fields = dict(f.split("=") for f in header.split(";"))
+                levels.append(int(fields["level"]))
+                assert fields["tier"] == "int8"  # L>=1: compressed
+            assert levels == [1, 2, 3, 3]  # the climb, one rung per tick
+            assert app.metrics.degradation_level.value() == 3
+            for lvl, want in (("1", 1), ("2", 1), ("3", 2)):
+                assert app.metrics.degradation_responses.value(
+                    level=lvl) == want
+            # the announced state is also /healthz-visible
+            health = json.loads(
+                urllib.request.urlopen(base + "/healthz").read()
+            )
+            assert health["degradation"]["level"] == 3
+            assert health["degradation"]["name"] == "coalesce"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        app.close()
+
+
+def test_full_fidelity_serving_carries_no_degraded_header():
+    app = make_fake_app(cfg=_degrade_cfg())
+    try:
+        from mine_tpu.serving.server import make_server
+
+        srv = make_server(app)
+        host, port = srv.server_address[:2]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            req = urllib.request.Request(
+                f"http://{host}:{port}/predict", data=_png(0),
+                headers={"Content-Type": "image/png"},
+            )
+            resp = urllib.request.urlopen(req)
+            assert resp.status == 200
+            assert resp.headers.get("X-Degraded") is None
+            assert app.metrics.degradation_level.value() == 0
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        app.close()
+
+
+def test_recovery_restores_fidelity_and_coalescing():
+    """After the climb, calm ticks walk back to L0 and the engine/batcher
+    overrides are actually withdrawn — not just the gauge."""
+    app = make_fake_app(cfg=_degrade_cfg(**{
+        "serving.degrade_relax_after": 1, "serving.degrade_dwell_s": 0.0,
+    }))
+    try:
+        normal_delay = app.batcher.max_delay_s
+        app.degrade.inject()
+        for _ in range(4):
+            app._degrade_tick()
+        assert app.degrade.level == 3
+        assert app.engine.effective_tier() == "int8"
+        assert app.batcher.max_delay_s == pytest.approx(
+            app._degraded_delay_s)
+        key_degraded = app.predict(_png(1))["mpi_key"]
+        assert key_degraded.split(":")[-1] == "int8"
+        for _ in range(3):
+            app._degrade_tick()  # calm: 3 -> 2 -> 1 -> 0
+        assert app.degrade.level == 0
+        assert app.engine.effective_tier() != "int8"
+        assert app.batcher.max_delay_s == pytest.approx(normal_delay)
+        levels = [lvl for _, lvl in app.degrade.transitions()]
+        assert all(abs(b - a) == 1 for a, b in zip(levels, levels[1:]))
+    finally:
+        app.close()
+
+
+def test_overload_spike_chaos_seam_drives_the_ladder():
+    """The drill's seam: `overload_spike@request=N` injects the synthetic
+    climb through the HTTP handler, no real flood needed."""
+    app = make_fake_app(cfg=_degrade_cfg())
+    try:
+        from mine_tpu.serving.server import make_server
+
+        srv = make_server(app)
+        host, port = srv.server_address[:2]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            chaos.install("overload_spike@request=1")
+            base = f"http://{host}:{port}"
+            for i in range(4):
+                req = urllib.request.Request(
+                    base + "/predict", data=_png(i + 10),
+                    headers={"Content-Type": "image/png"},
+                )
+                assert urllib.request.urlopen(req).status == 200
+            assert app.degrade.level == 3
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        app.close()
+
+
+# ------------------------------------------------------ autoscale coupling
+
+
+def _expo(burn: float, level: int | None) -> str:
+    text = f'mine_slo_burn_rate{{slo="availability"}} {burn}\n'
+    if level is not None:
+        text += f"mine_fleet_degradation_level {level}\n"
+    return text
+
+
+def test_degradation_from_exposition_reads_max_sample():
+    from mine_tpu.obs.slo import degradation_from_exposition
+
+    assert degradation_from_exposition("") is None
+    assert degradation_from_exposition(_expo(0.0, None)) is None
+    assert degradation_from_exposition(_expo(0.0, 2)) == 2.0
+    # decoy prefixes don't match
+    assert degradation_from_exposition(
+        "mine_fleet_degradation_level_other 9\n") is None
+
+
+def _autoscale(scrape_text: str, **kw):
+    from mine_tpu.serving.autoscale import AutoscaleController
+    from mine_tpu.serving.fleet import FleetApp
+
+    def transport(method, url, body, headers, timeout_s):
+        return (200, {}, b"{}")
+
+    fleet = FleetApp(
+        {f"r{i}": f"http://r{i}" for i in range(2)},
+        transport=transport, probe_interval_s=3600,
+    )
+
+    class NullPool:
+        def spawn(self):
+            raise RuntimeError("null pool")
+
+        def names(self):
+            return []
+
+        def retire(self, name):
+            pass
+
+        def close(self):
+            pass
+
+    cell = [scrape_text]
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_s", 0.0)
+    ctl = AutoscaleController(
+        fleet, NullPool(), lambda: cell[0], clock=lambda: 0.0, **kw,
+    )
+    return ctl, cell
+
+
+def test_sustained_degradation_is_a_scale_up_signal():
+    ctl, cell = _autoscale(_expo(0.0, 2), up_after=2, degrade_up_level=1)
+    rec = ctl.tick()
+    assert rec["action"] == "hold"  # breach 1 of 2
+    assert rec["degradation_level"] == 2.0
+    assert ctl.tick()["action"] == "scale_up"  # burn calm, ladder loud
+    # not sustained: one calm scrape resets the streak
+    cell[0] = _expo(0.0, 0)
+    assert ctl.tick()["action"] != "scale_up"
+
+
+def test_degraded_fleet_is_never_scaled_down():
+    ctl, cell = _autoscale(_expo(0.0, 1), down_after=1, degrade_up_level=1)
+    assert ctl.tick()["action"] == "hold"  # calm burn but L1: not calm
+    cell[0] = _expo(0.0, 0)
+    assert ctl.tick()["action"] == "scale_down"  # back at L0: all-clear
+
+
+def test_degrade_signal_off_by_default():
+    # degrade_up_level=0 (the default) ignores the gauge entirely
+    ctl, _cell = _autoscale(_expo(0.0, 3), up_after=1, down_after=1)
+    assert ctl.tick()["action"] == "scale_down"
+
+
+# --------------------------------------------------- breaker half-open jitter
+
+
+def test_breaker_jitter_desynchronizes_shared_trips():
+    from mine_tpu.resilience.breaker import CircuitBreaker
+
+    clock = [0.0]
+    breakers = [
+        CircuitBreaker(failure_threshold=1, reset_after_s=10.0,
+                       reset_jitter=0.2, jitter_seed=seed,
+                       clock=lambda: clock[0])
+        for seed in (1, 2)
+    ]
+    for b in breakers:
+        b.record_failure()  # fleet-wide event: both trip at t=0
+        assert b.state == "open"
+    windows = [b._effective_reset_s for b in breakers]
+    assert windows[0] != windows[1]  # distinct seeds: distinct re-probes
+    for w in windows:
+        assert 8.0 <= w <= 12.0  # within +-20% of reset_after_s
+    early, late = sorted(zip(windows, breakers))
+    clock[0] = early[0] + 1e-6
+    assert early[1].state == "half_open"
+    assert late[1].state == "open"  # NOT in lockstep
+    clock[0] = late[0] + 1e-6
+    assert late[1].state == "half_open"
+
+
+def test_breaker_without_jitter_keeps_exact_reset_timing():
+    from mine_tpu.resilience.breaker import CircuitBreaker
+
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_after_s=10.0,
+                       clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] = 9.999
+    assert b.state == "open"
+    clock[0] = 10.0
+    assert b.state == "half_open"
+    with pytest.raises(ValueError):
+        CircuitBreaker(reset_jitter=1.0)  # [0, 1) enforced
+
+
+# ---------------------------------------------------- checkpoint integrity
+
+
+def _fabricate_step(tmp_path, step: int) -> str:
+    ws = str(tmp_path / "ws")
+    root = tmp_path / "ws" / "checkpoints" / str(step) / "params"
+    root.mkdir(parents=True)
+    (root / "data.bin").write_bytes(b"\x01\x02\x03" * 100)
+    (root.parent / "manifest.json").write_text('{"leaves": 1}')
+    return ws
+
+def test_integrity_sidecar_roundtrip_and_tamper_detection(tmp_path):
+    from mine_tpu.training.checkpoint import (
+        CheckpointCorrupt,
+        verify_checkpoint_integrity,
+        write_integrity_sidecar,
+    )
+
+    ws = _fabricate_step(tmp_path, 5)
+    write_integrity_sidecar(ws, 5)
+    verify_checkpoint_integrity(ws, 5)  # pristine: passes
+    # a single flipped byte is named, not a deep deserialize traceback
+    victim = tmp_path / "ws" / "checkpoints" / "5" / "params" / "data.bin"
+    blob = bytearray(victim.read_bytes())
+    blob[7] ^= 0xFF
+    victim.write_bytes(bytes(blob))
+    with pytest.raises(CheckpointCorrupt) as exc:
+        verify_checkpoint_integrity(ws, 5)
+    assert "data.bin" in str(exc.value)
+    # truncation changes the byte count: also named
+    victim.write_bytes(b"\x01")
+    with pytest.raises(CheckpointCorrupt):
+        verify_checkpoint_integrity(ws, 5)
+
+
+def test_integrity_verifies_vacuously_for_legacy_and_remote(tmp_path):
+    from mine_tpu.training.checkpoint import verify_checkpoint_integrity
+
+    ws = _fabricate_step(tmp_path, 3)
+    verify_checkpoint_integrity(ws, 3)  # no sidecar: legacy, passes
+    verify_checkpoint_integrity("gs://bucket/run", 3)  # remote: vacuous
+
+
+def test_corrupt_ckpt_swap_rejected_old_generation_serving():
+    app = make_fake_app(checkpoint_step=1,
+                        swap_source=lambda: fake_checkpoint(2))
+    try:
+        chaos.install("corrupt_ckpt@swap=1")
+        status = app.swap(wait=True)
+        assert status["state"] == "failed" and status["reason"] == "corrupt"
+        assert "CheckpointCorrupt" in status["error"]
+        assert app.metrics.swap_failures.value(reason="corrupt") == 1
+        assert app.engine.generation == 0
+        assert app.predict(_png(2))["mpi_key"].split(":")[1] == "1"
+        # the fault fired once: the next swap flips normally
+        assert app.swap(wait=True)["state"] == "ok"
+    finally:
+        app.close()
+
+
+# ------------------------------------------------- README ladder drift
+
+
+_LADDER_BEGIN = "<!-- degradation-ladder:begin -->"
+_LADDER_END = "<!-- degradation-ladder:end -->"
+_ROW_RE = re.compile(r"^\|\s*(\d+)\s*\|\s*`([a-z_]+)`", re.MULTILINE)
+
+
+def _documented_ladder() -> dict[int, str]:
+    text = (REPO / "README.md").read_text()
+    begin = text.index(_LADDER_BEGIN)
+    end = text.index(_LADDER_END)
+    return {int(lvl): name for lvl, name in
+            _ROW_RE.findall(text[begin:end])}
+
+
+def test_readme_ladder_table_matches_code_both_directions():
+    documented = _documented_ladder()
+    coded = {lvl: name for lvl, (name, _) in LADDER.items()}
+    missing = set(coded.items()) - set(documented.items())
+    assert not missing, (
+        f"ladder levels in degrade.LADDER but missing/misnamed in README's "
+        f"degradation-ladder table: {sorted(missing)} — add/fix the row "
+        "between the degradation-ladder markers"
+    )
+    stale = set(documented.items()) - set(coded.items())
+    assert not stale, (
+        f"README documents ladder levels degrade.LADDER does not define: "
+        f"{sorted(stale)} — delete the stale rows"
+    )
+    assert len(documented) == MAX_LEVEL + 1  # the scan saw the table

@@ -319,19 +319,14 @@ def test_serving_metrics_histograms_and_debug_trace(tiny_serving_app):
     except urllib.error.HTTPError as err:
         assert err.code == 400
 
-    # the handler observes request_latency AFTER writing the response, so
-    # a scrape racing the tail of the 400's handler thread can miss the
-    # sample — poll briefly instead of asserting on the first page
+    # the handler observes request_latency BEFORE writing the response
+    # (server.py _observe), so a client that saw its 400 is GUARANTEED to
+    # find it counted on the very next scrape — no polling needed
     bucket_line = ('mine_serve_request_latency_seconds_bucket'
                    '{endpoint="render",le="+Inf"} 1')
-    deadline = time.monotonic() + 5.0
-    while True:
-        status, body = _get(base, "/metrics")
-        assert status == 200
-        text = body.decode()
-        if bucket_line in text or time.monotonic() >= deadline:
-            break
-        time.sleep(0.05)
+    status, body = _get(base, "/metrics")
+    assert status == 200
+    text = body.decode()
     assert "# TYPE mine_serve_request_latency_seconds histogram" in text
     assert "# TYPE mine_serve_queue_delay_seconds histogram" in text
     assert "# TYPE mine_serve_trace_spans_total counter" in text

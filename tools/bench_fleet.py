@@ -52,6 +52,13 @@ importable for the tier-1 smoke.
     # cache-aware pre-warm/handoff; zero 5xx and fleet-wide
     # encoder_invocations == images asserted across BOTH transitions
     # (run_ramp; dedicated fleet_scale ledger stream)
+  python tools/bench_fleet.py --brownout               # brownout proof:
+    # the SAME open-loop overload flood replayed against a ladder-off
+    # fleet (bounded queues overflow, >= 10% shed 503) and a brownout
+    # fleet (serving/degrade.py; >= 99% answered 200 under the p95 SLO
+    # ceiling, fidelity traded via X-Degraded, full ladder recovery
+    # within the dwell budget once the flood drains)
+    # (run_brownout; dedicated fleet_brownout ledger stream)
 """
 
 from __future__ import annotations
@@ -75,6 +82,7 @@ METRIC = "fleet_renders_per_sec"
 ECON_METRIC = "fleet_cache_economics"
 MIXED_METRIC = "fleet_mixed_bucket"
 RAMP_METRIC = "fleet_scale"
+BROWNOUT_METRIC = "fleet_brownout"
 BENCH_PLANES = 8  # enough planes that pruning has something to prune
 
 # the default mixed-bucket shape set: three genuinely different (H, W, S)
@@ -110,6 +118,30 @@ def _http(base: str, path: str, data=None, headers=None, timeout=120):
         return err.code, err.read()
 
 
+def _http_h(base: str, path: str, data=None, headers=None, timeout=120):
+    """_http plus the response headers — the brownout flood counts every
+    X-Degraded announcement CLIENT-side, not just off replica counters."""
+    req = urllib.request.Request(base + path, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        return err.code, err.read(), dict(err.headers or {})
+
+
+def _degraded_level_of(headers: dict) -> int:
+    """Ladder level out of an `X-Degraded: level=<n>;tier=<t>` header
+    (0 when absent — absence IS the full-fidelity announcement)."""
+    for k, v in headers.items():
+        if k.lower() == "x-degraded":
+            try:
+                return int(str(v).split(";", 1)[0].split("=", 1)[1])
+            except (IndexError, ValueError):
+                return 0
+    return 0
+
+
 def _metric_value(text: str, name: str, default=0.0) -> float:
     total, seen = 0.0, False
     for line in text.splitlines():
@@ -117,6 +149,18 @@ def _metric_value(text: str, name: str, default=0.0) -> float:
             total += float(line.rsplit(" ", 1)[1])
             seen = True
     return total if seen else default
+
+
+def _metric_by_label(text: str, name: str, label: str) -> dict[str, float]:
+    """Per-label-value sums for one family (e.g. degradation responses
+    broken out by ladder level)."""
+    out: dict[str, float] = {}
+    needle = f'{label}="'
+    for line in text.splitlines():
+        if line.startswith(name + "{") and needle in line:
+            val = line.split(needle, 1)[1].split('"', 1)[0]
+            out[val] = out.get(val, 0.0) + float(line.rsplit(" ", 1)[1])
+    return out
 
 
 def _bench_cfg(tier: str, prune_eps: float):
@@ -953,6 +997,392 @@ def run_ramp(
         pool.close()
 
 
+def run_brownout(
+    replicas: int = 2,
+    images_per_replica: int = 32,
+    rate_per_s: float = 40.0,
+    duration_s: float = 8.0,
+    workers: int = 64,
+    render_delay_s: float = 0.06,
+    queue_bound: int = 12,
+    vnodes: int | None = None,
+) -> dict:
+    """The brownout proof: ONE open-loop overload flood, replayed twice.
+
+    Pass 1 (ladder off) establishes that the flood IS an overload: arrival
+    outruns render capacity, the bounded queues overflow, and admission
+    control sheds — >= 10% of requests answer 503 (and nothing worse:
+    codes stay inside {200, 503}). Pass 2 (ladder on) replays the
+    IDENTICAL trace at the identical pacing against the degradation
+    ladder (serving/degrade.py): L1's int8+pruned entries run smaller
+    render dispatches (FakeEngine scales its delay by planes kept — the
+    real engine's cost model in miniature), so the same arrival rate now
+    fits under capacity and the fleet answers >= 99% with 200 while the
+    p95 stays under serving.slo_p95_ms — availability bought with
+    fidelity, every degraded answer announced via X-Degraded (counted
+    client-side AND off the replica counters).
+
+    The flood is OPEN-LOOP on purpose: requests fire on a wall-clock
+    schedule (t0 + i/rate) regardless of completions. A closed-loop
+    client pool can never overflow a queue deeper than its own
+    concurrency — it measures the client, not the overload.
+
+    The image set is pre-split by ring owner so each replica sees an even
+    arrival rate (the raw digest split is not even), and distinct images
+    round-robin so the batcher's same-key coalescing cannot absorb the
+    backlog on its own.
+
+    Gates (raise on violation — bench.py discipline):
+      * ladder-off: shed rate >= 10%, codes subset of {200, 503};
+      * brownout:  ok rate >= 99%, client p95 <= serving.slo_p95_ms,
+        codes subset of {200, 503}, >= 1 degraded answer on BOTH the
+        client ledger and the replica counters;
+      * recovery: after the flood drains, every replica's
+        mine_serve_degradation_level returns to 0 within the dwell
+        budget (level * (dwell + slack) + slack — /metrics scrapes tick
+        the ladder, so an idle replica still relaxes) and the router's
+        mine_fleet_degradation_level follows on probe cadence.
+
+    The CLI appends the brownout availability to the dedicated
+    `fleet_brownout` ledger stream (p95 gated by `perf_ledger.py check`,
+    which the chaos drill's final verdict inherits).
+    """
+    import hashlib
+
+    import numpy as np
+
+    from mine_tpu.config import Config
+    from mine_tpu.serving.fake import make_fake_app
+    from mine_tpu.serving.fleet import DEFAULT_VNODES, FleetApp, HashRing, \
+        make_fleet_server
+    from mine_tpu.serving.server import make_server
+
+    if vnodes is None:
+        vnodes = DEFAULT_VNODES
+    names = [f"r{i}" for i in range(replicas)]
+    ring = HashRing(names, vnodes)
+    per_owner: dict[str, list[bytes]] = {n: [] for n in names}
+    for png in _make_pngs(8 * replicas * images_per_replica):
+        owner = ring.candidates(hashlib.sha256(png).hexdigest())[0]
+        if len(per_owner[owner]) < images_per_replica:
+            per_owner[owner].append(png)
+        if all(len(v) == images_per_replica for v in per_owner.values()):
+            break
+    short = [n for n, v in per_owner.items()
+             if len(v) < images_per_replica]
+    if short:
+        raise RuntimeError(f"could not fill the per-owner image quota "
+                           f"for {short} — enlarge the candidate pool")
+    # owners interleave in the trace: every replica's arrival rate is
+    # rate_per_s / replicas, sustained
+    trace = [per_owner[n][j] for j in range(images_per_replica)
+             for n in names]
+    n_requests = int(rate_per_s * duration_s)
+    dwell_s = 0.25
+
+    def one_pass(degrade_enabled: bool) -> dict:
+        cfg = Config().replace(**{
+            "data.img_h": 128, "data.img_w": 128,
+            "mpi.num_bins_coarse": BENCH_PLANES,
+            "resilience.serve_max_queue_requests": queue_bound,
+            "serving.degrade_enabled": degrade_enabled,
+            "serving.degrade_queue_high": 0.5,
+            "serving.degrade_queue_low": 0.125,
+            "serving.degrade_engage_after": 2,
+            "serving.degrade_relax_after": 2,
+            "serving.degrade_dwell_s": dwell_s,
+            # the burn windows TRAIL (slo_window_s): flood latencies would
+            # keep the burn signal hot long after the queue drained, so on
+            # the bench timescale queue_frac alone must drive the ladder —
+            # saturate the burn thresholds out of reach
+            "serving.degrade_burn_low": 1e9,
+            "serving.degrade_burn_high": 1e12,
+        })
+        apps, servers, urls = [], [], {}
+        fleet = fleet_srv = None
+        try:
+            for i in range(replicas):
+                app = make_fake_app(cfg=cfg,
+                                    render_delay_s=render_delay_s)
+                srv = make_server(app)
+                host, port = srv.server_address[:2]
+                threading.Thread(target=srv.serve_forever,
+                                 daemon=True).start()
+                apps.append(app)
+                servers.append(srv)
+                urls[names[i]] = f"http://{host}:{port}"
+            for i, app in enumerate(apps):
+                app.configure_peers(urls, names[i], vnodes=vnodes)
+            fleet = FleetApp(urls, probe_interval_s=0.2,
+                             vnodes=vnodes).start()
+            fleet_srv = make_fleet_server(fleet)
+            fh, fp = fleet_srv.server_address[:2]
+            threading.Thread(target=fleet_srv.serve_forever,
+                             daemon=True).start()
+            base = f"http://{fh}:{fp}"
+
+            hdr_png = {"Content-Type": "image/png"}
+            hdr_json = {"Content-Type": "application/json"}
+            # seed: the working set resident on its owners (steady state)
+            # — the flood measures render capacity, not first-touch
+            # encoder passes
+            for png in trace:
+                code, body = _http(base, "/predict", data=png,
+                                   headers=hdr_png)
+                assert code == 200, body
+            if degrade_enabled:
+                # seed the DEGRADED working set too: L1's capacity lever
+                # is the smaller pruned dispatch, and a real fleet
+                # amortizes the one-time cost of minting its int8 entries
+                # across the cache lifetime — minting all of them inside
+                # the flood's tier flip would serialize a multi-second
+                # encode burst and measure a cold-start artifact instead
+                # of the ladder (L1 semantics: degrade.py tier/prune
+                # overrides, the same entries the flood's L1 predicts key)
+                from mine_tpu.serving.compress import DEFAULT_PRUNE_EPS
+
+                for app in apps:
+                    app.engine.set_degraded_compression(
+                        "int8", DEFAULT_PRUNE_EPS)
+                for png in trace:
+                    code, body = _http(base, "/predict", data=png,
+                                       headers=hdr_png)
+                    assert code == 200, body
+                for app in apps:
+                    app.engine.clear_degraded_compression()
+
+            idx = [0]
+            lock = threading.Lock()
+            records: list[tuple[int, float, int]] = []
+            transport_errors: list[str] = []
+            t0 = time.perf_counter() + 0.1
+
+            def one_request(png) -> tuple[int, int]:
+                """predict -> render the response's OWN mpi_key, honoring
+                the documented 404 contract (the render failed over to a
+                replica that never cached this MPI and its peer fetch lost
+                the race -> re-predict there, render again), exactly like
+                the chaos drill's fleet client. Returns (code, max
+                X-Degraded level seen across the exchange)."""
+                level = 0
+                code = 404
+                for _attempt in range(2):
+                    c1, b1, h1 = _http_h(base, "/predict", data=png,
+                                         headers=hdr_png)
+                    level = max(level, _degraded_level_of(h1))
+                    if c1 != 200:
+                        return c1, level
+                    payload = json.dumps({
+                        "mpi_key": json.loads(b1)["mpi_key"],
+                        "offsets": [[0.01, 0.0, 0.0]],
+                    }).encode()
+                    code, _, h2 = _http_h(base, "/render", data=payload,
+                                          headers=hdr_json)
+                    level = max(level, _degraded_level_of(h2))
+                    if code != 404:
+                        return code, level
+                return code, level
+
+            def flood_worker():
+                while True:
+                    with lock:
+                        i = idx[0]
+                        if i >= n_requests:
+                            return
+                        idx[0] += 1
+                    fire = t0 + i / rate_per_s
+                    delay = fire - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    try:
+                        code, level = one_request(trace[i % len(trace)])
+                    except Exception as exc:  # noqa: BLE001 - recorded
+                        with lock:
+                            transport_errors.append(
+                                f"{type(exc).__name__}: {exc}")
+                            records.append((599, 0.0, 0))
+                        continue
+                    # open-loop latency: measured from the SCHEDULED
+                    # arrival, so a worker held back by overload counts
+                    # the wait it imposed on its request
+                    rtt = time.perf_counter() - fire
+                    with lock:
+                        records.append((code, rtt, level))
+
+            threads = [threading.Thread(target=flood_worker)
+                       for _ in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+
+            codes = [r[0] for r in records]
+            ok_lat = sorted(r[1] for r in records if r[0] == 200)
+            client_levels: dict[int, int] = {}
+            for _, _, lvl in records:
+                if lvl > 0:
+                    client_levels[lvl] = client_levels.get(lvl, 0) + 1
+            replica_levels: dict[str, float] = {}
+            gauges: dict[str, float] = {}
+            for name, url in urls.items():
+                _, body = _http(url, "/metrics")
+                text = body.decode()
+                for lvl, v in _metric_by_label(
+                        text, "mine_serve_degradation_responses_total",
+                        "level").items():
+                    replica_levels[lvl] = replica_levels.get(lvl, 0.0) + v
+                gauges[name] = _metric_value(
+                    text, "mine_serve_degradation_level")
+            out = {
+                "degrade_enabled": degrade_enabled,
+                "transport_errors": transport_errors[:5],
+                "requests": len(records),
+                "ok": sum(1 for c in codes if c == 200),
+                "shed": sum(1 for c in codes if c == 503),
+                "codes": sorted(set(codes)),
+                "ok_rate": round(
+                    sum(1 for c in codes if c == 200)
+                    / max(len(codes), 1), 4),
+                "p50_ms": round(
+                    1e3 * float(np.percentile(ok_lat, 50)), 1)
+                if ok_lat else None,
+                "p95_ms": round(
+                    1e3 * float(np.percentile(ok_lat, 95)), 1)
+                if ok_lat else None,
+                "degraded_responses_client": {
+                    str(k): v for k, v in sorted(client_levels.items())},
+                "degraded_responses_replica": {
+                    k: replica_levels[k] for k in sorted(replica_levels)},
+                "max_level_seen": max(
+                    (lvl for _, _, lvl in records), default=0),
+            }
+            if degrade_enabled:
+                # recovery: /metrics scrapes tick the ladder, so polling
+                # IS the relax cadence an idle replica lives on; the
+                # budget is the dwell the ladder still owes (one dwell
+                # per remaining level) plus scheduling slack
+                level_at_end = int(max(gauges.values(), default=0))
+                budget = level_at_end * (dwell_s + 1.0) + 2.0
+                t_rec = time.perf_counter()
+                remaining = dict(gauges)
+                while time.perf_counter() - t_rec < budget:
+                    remaining = {}
+                    for name, url in urls.items():
+                        _, body = _http(url, "/metrics")
+                        remaining[name] = _metric_value(
+                            body.decode(), "mine_serve_degradation_level")
+                    if all(v == 0 for v in remaining.values()):
+                        break
+                    time.sleep(0.05)
+                out["level_at_flood_end"] = level_at_end
+                out["replica_recovery_s"] = round(
+                    time.perf_counter() - t_rec, 2)
+                out["replica_recovered"] = all(
+                    v == 0 for v in remaining.values())
+                # the router's fleet gauge follows on probe cadence (the
+                # /healthz degradation snapshot refresh in probe_once)
+                router_gauge = None
+                t_router = time.perf_counter()
+                while time.perf_counter() - t_router < 5.0:
+                    _, body = _http(base, "/metrics")
+                    router_gauge = _metric_value(
+                        body.decode(), "mine_fleet_degradation_level")
+                    if router_gauge == 0:
+                        break
+                    time.sleep(0.05)
+                out["router_recovered"] = router_gauge == 0
+            return out
+        finally:
+            for srv in servers:
+                try:
+                    srv.shutdown()
+                    srv.server_close()
+                except OSError:
+                    pass
+            if fleet_srv is not None:
+                fleet_srv.shutdown()
+                fleet_srv.server_close()
+            if fleet is not None:
+                fleet.close()
+            for app in apps:
+                app.close()
+
+    off = one_pass(False)
+    on = one_pass(True)
+
+    if not set(off["codes"]) <= {200, 503}:
+        raise RuntimeError(
+            f"ladder-off flood produced unplanned codes {off['codes']} — "
+            "admission control sheds 503, never an unplanned status"
+        )
+    shed_rate = round(1.0 - off["ok_rate"], 4)
+    if shed_rate < 0.10:
+        raise RuntimeError(
+            f"ladder-off flood shed only {shed_rate:.1%} — the trace is "
+            "not an overload, the brownout comparison proves nothing "
+            "(gate >= 10%)"
+        )
+    if not set(on["codes"]) <= {200, 503}:
+        raise RuntimeError(
+            f"brownout flood produced unplanned codes {on['codes']}"
+        )
+    if on["ok_rate"] < 0.99:
+        raise RuntimeError(
+            f"brownout availability {on['ok_rate']:.2%} under the "
+            "identical flood (gate >= 99%) — the ladder did not relieve "
+            "the queue"
+        )
+    slo_ceiling_ms = float(Config().serving.slo_p95_ms)
+    if on["p95_ms"] is None or on["p95_ms"] > slo_ceiling_ms:
+        raise RuntimeError(
+            f"brownout client p95 {on['p95_ms']} ms exceeds the "
+            f"serving.slo_p95_ms ceiling {slo_ceiling_ms} ms — "
+            "availability without latency is not availability"
+        )
+    if (not on["degraded_responses_client"]
+            or not on["degraded_responses_replica"]):
+        raise RuntimeError(
+            "brownout pass never served a degraded answer — the "
+            "availability was not bought with fidelity, something else "
+            "absorbed the flood"
+        )
+    if not on["replica_recovered"]:
+        raise RuntimeError(
+            f"replicas still degraded {on['replica_recovery_s']}s after "
+            f"the flood drained (from level {on['level_at_flood_end']}) "
+            "— the ladder must fully relax within the dwell budget"
+        )
+    if not on["router_recovered"]:
+        raise RuntimeError(
+            "router fleet gauge never returned to 0 — idle-replica "
+            "recovery is not reaching the probe path"
+        )
+
+    return {
+        "metric": BROWNOUT_METRIC,
+        "value": on["ok_rate"],
+        "unit": "availability",
+        "replicas": replicas, "images": len(trace),
+        "requests": n_requests, "rate_per_s": rate_per_s,
+        "duration_s": duration_s, "render_delay_s": render_delay_s,
+        "queue_bound": queue_bound, "engine": "fake",
+        "router_p50_ms": on["p50_ms"],
+        "router_p95_ms": on["p95_ms"],
+        "slo_p95_ceiling_ms": slo_ceiling_ms,
+        "shed_rate_ladder_off": shed_rate,
+        "availability_gain": round(on["ok_rate"] - off["ok_rate"], 4),
+        "ladder_off": off,
+        "brownout": on,
+        "note": (
+            "identical open-loop flood, two fleets: ladder-off overflows "
+            "its bounded queues and sheds; the brownout ladder trades "
+            "fidelity (int8+pruned renders, announced via X-Degraded) "
+            "for >= 99% availability under the p95 SLO ceiling, then "
+            "fully relaxes within the dwell budget"
+        ),
+    }
+
+
 def _append_ledger_rows(result: dict, compare: dict | None,
                         args, compare_tier: str | None = None) -> list[dict]:
     """The dedicated fleet stream + one tier-keyed economics stream per
@@ -1054,6 +1484,14 @@ def main() -> None:
                     "with cache-aware pre-warm/handoff; zero 5xx + "
                     "encoder conservation asserted (dedicated fleet_scale "
                     "ledger stream)")
+    ap.add_argument("--brownout", action="store_true",
+                    help="run the brownout-ladder overload proof instead "
+                    "of the homogeneous trace: one open-loop flood "
+                    "replayed against a ladder-off fleet (>= 10% shed "
+                    "503) and a degradation-ladder fleet (>= 99% "
+                    "answered 200 under the p95 SLO ceiling, fidelity "
+                    "traded via X-Degraded, full recovery after the "
+                    "flood; dedicated fleet_brownout ledger stream)")
     ap.add_argument("--zoo", action="store_true",
                     help="with --mixed-bucket: use the pretrained-zoo "
                     "capability-envelope shapes (RealEstate10K 256x384x64, "
@@ -1065,6 +1503,62 @@ def main() -> None:
     from mine_tpu.utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
+
+    if args.brownout:
+        # the brownout scenario sizes its OWN flood: the arrival rate vs
+        # render capacity ratio is the measured quantity, so a riding
+        # --requests/--replicas/--tier would silently unbalance the
+        # overload it proves — refuse, same contract as --ramp below
+        ignored = [
+            flag for flag, is_default in (
+                ("--ramp", not args.ramp),
+                ("--mixed-bucket", not args.mixed_bucket),
+                ("--zoo", not args.zoo),
+                ("--real", not args.real),
+                ("--replicas", args.replicas == 3),
+                ("--images", args.images == 12),
+                ("--requests", args.requests == 150),
+                ("--concurrency", args.concurrency == 6),
+                ("--tier", args.tier == "fp32"),
+                ("--prune-eps", args.prune_eps is None),
+                ("--cache-mb", args.cache_mb == 2048),
+                ("--no-peer-fetch", not args.no_peer_fetch),
+            ) if not is_default
+        ]
+        if ignored:
+            ap.error(
+                f"--brownout does not support {', '.join(ignored)}: the "
+                "brownout proof runs a self-sized open-loop overload "
+                "flood on a fake-engine fp32 fleet (its gates are shed "
+                "rate, availability, the p95 SLO ceiling, and ladder "
+                "recovery)"
+            )
+        result = run_brownout()
+        try:
+            import jax
+
+            from mine_tpu.obs import ledger
+
+            row = ledger.append_bench_row({
+                "metric": BROWNOUT_METRIC, "value": result["value"],
+                "unit": "availability", "higher_is_better": True,
+                "p50_ms": result["router_p50_ms"],
+                "p95_ms": result["router_p95_ms"],
+                "device": jax.devices()[0].device_kind,
+                "backend": jax.default_backend(),
+            }, workload={
+                "replicas": result["replicas"],
+                "images": result["images"],
+                "requests": result["requests"],
+                "rate_per_s": result["rate_per_s"],
+                "engine": "fake", "scenario": "brownout",
+            })
+            if row is not None:
+                result["ledger_rows"] = 1
+        except Exception as exc:  # noqa: BLE001 - number outranks ledger
+            print(f"# perf-ledger update failed: {exc}", file=sys.stderr)
+        print(json.dumps(result))
+        return
 
     if args.ramp:
         # the ramp is fake-engine fp32 at an unconstrained budget by

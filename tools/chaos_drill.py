@@ -51,6 +51,18 @@ controller, mine_tpu/serving/autoscale.py):
   zero 5xx; the only cost is cache warmth (measured as an
   encoder-invocation delta, not gated).
 
+Brownout half (one in-process fake-weight replica: the degradation
+ladder, mine_tpu/serving/degrade.py — bench_fleet.py --brownout is the
+capacity proof, this half proves the state machine):
+  `overload_spike@request=1` injects the synthetic overload through the
+  live HTTP handler: the ladder climbs to max ONE level at a time, every
+  degraded answer carries `X-Degraded: level=<n>;tier=<t>` + ticks the
+  per-level counter, then fully recovers one level per dwell (never
+  skipping) back to full fidelity. `corrupt_ckpt@swap=1`: a swap whose
+  checkpoint fails integrity verification is REJECTED with
+  reason="corrupt" (CheckpointCorrupt named, counter ticked), the old
+  generation keeps serving, and the next swap flips normally.
+
 Multihost half (REAL jax.distributed multi-process training via
 tools/multihost_harness.py — N subprocesses on one box, the code path a
 pod runs; slow, run explicitly or via --half all):
@@ -92,7 +104,7 @@ outcomes. `tools/conformance_run.py` is the standalone spelling.
 
 Usage:
   python tools/chaos_drill.py [--half training|serving|fleet|scale|
-                               multihost|datasets|all]
+                               brownout|multihost|datasets|all]
                               [--workdir DIR] [--no-exact] [--steps N]
 """
 
@@ -649,6 +661,218 @@ def fleet_half(timeout_s: float) -> dict:
         if fleet is not None:
             fleet.close()
         for app in apps:
+            app.close()
+    return result
+
+
+def brownout_half(timeout_s: float) -> dict:
+    """Degradation-ladder + checkpoint-integrity drill against one live
+    fake-weight replica (zero XLA compiles; tools/bench_fleet.py
+    --brownout is the CAPACITY proof — this half proves the state
+    machine and the announce contract).
+
+    `overload_spike@request=1`: the first handled request injects the
+    synthetic overload into the ladder (serving/degrade.py) through the
+    HTTP handler — the climb must reach max level ONE level at a time,
+    every answer served above level 0 must carry the X-Degraded
+    announcement (level AND effective tier) and tick the per-level
+    response counter, and once the synthetic pressure drains the ladder
+    must descend one level per dwell, never skipping, back to full
+    fidelity (no header, fp32 tier).
+
+    `corrupt_ckpt@swap=1`: the swap's checkpoint fails integrity
+    verification (training/checkpoint.py sidecar, CheckpointCorrupt) —
+    the swap is REJECTED with reason="corrupt" + the named error +
+    counter, the old generation keeps serving (follow-up predicts still
+    mint old-generation keys, nothing 5xxs), and the NEXT swap (fault
+    exhausted) flips normally."""
+    import io
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+    from PIL import Image
+
+    from mine_tpu.config import Config
+    from mine_tpu.resilience import chaos
+    from mine_tpu.serving.fake import fake_checkpoint, make_fake_app
+    from mine_tpu.serving.server import make_server
+
+    result: dict = {}
+    app = srv = None
+    try:
+        cfg = Config().replace(**{
+            "data.img_h": 128, "data.img_w": 128,
+            "mpi.num_bins_coarse": 2,
+            "serving.degrade_enabled": True,
+            # one breach per level: the spike's injected ticks walk the
+            # ladder a request at a time; dwell long enough that the
+            # climb assertions (a handful of fast requests + scrapes)
+            # never race a relax, short enough that the recovery phase
+            # proves the full descent inside the drill budget
+            "serving.degrade_engage_after": 1,
+            "serving.degrade_relax_after": 2,
+            "serving.degrade_dwell_s": 1.0,
+        })
+        app = make_fake_app(checkpoint_step=1,
+                            swap_source=lambda: fake_checkpoint(2),
+                            cfg=cfg)
+        srv = make_server(app)
+        host, port = srv.server_address[:2]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://{host}:{port}"
+
+        def http(path, data=None, headers=None, timeout=30.0):
+            req = urllib.request.Request(base + path, data=data,
+                                         headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status, resp.read(), dict(resp.headers)
+            except urllib.error.HTTPError as err:
+                return err.code, err.read(), dict(err.headers or {})
+
+        def png(i: int) -> bytes:
+            img = np.full((8, 8, 3), (i * 53) % 256, np.uint8)
+            img[0, 0] = (i, 1, 0)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="PNG")
+            return buf.getvalue()
+
+        def degraded_header(headers: dict) -> tuple[int, str | None]:
+            for k, v in headers.items():
+                if k.lower() == "x-degraded":
+                    lvl = int(str(v).split(";", 1)[0].split("=", 1)[1])
+                    tier = str(v).split("tier=", 1)[1] if "tier=" in v \
+                        else None
+                    return lvl, tier
+            return 0, None
+
+        def metric(name: str) -> float:
+            _, body, _ = http("/metrics")
+            total, seen = 0.0, False
+            for line in body.decode().splitlines():
+                if line.startswith(name) and line[len(name)] in " {":
+                    total += float(line.rsplit(" ", 1)[1])
+                    seen = True
+            return total if seen else 0.0
+
+        # ---- phase A: the spike climbs the ladder, announced ---------------
+        code, _, hdr = http("/predict", data=png(0),
+                            headers={"Content-Type": "image/png"})
+        assert code == 200
+        lvl0, _ = degraded_header(hdr)
+        result["pre_spike_full_fidelity"] = lvl0 == 0
+
+        schedule = chaos.install("overload_spike@request=1")
+        climb: list[tuple[int, str | None]] = []
+        for i in range(4):
+            code, _, hdr = http("/predict", data=png(i + 1),
+                                headers={"Content-Type": "image/png"})
+            assert code == 200
+            climb.append(degraded_header(hdr))
+        chaos.uninstall()
+        result["spike_fired"] = schedule.pending() == []
+        levels = [lvl for lvl, _ in climb]
+        result["climb_levels"] = levels
+        # the announce contract: the synthetic overload walks the ladder
+        # one level per breach tick to max, and every degraded answer
+        # carries the header with the tier actually serving it
+        result["climb_reaches_max"] = max(levels) == app.degrade.max_level
+        result["climb_one_level_at_a_time"] = levels == sorted(levels) and \
+            all(b - a <= 1 for a, b in zip([0] + levels, levels))
+        result["degraded_answers_announced"] = all(
+            lvl == 0 or tier is not None for lvl, tier in climb
+        )
+        result["compressed_tier_announced"] = all(
+            tier == "int8" for lvl, tier in climb if lvl >= 1
+        )
+        result["degraded_responses_counted"] = metric(
+            "mine_serve_degradation_responses_total"
+        ) >= sum(1 for lvl, _ in climb if lvl > 0)
+        result["gauge_at_max"] = metric(
+            "mine_serve_degradation_level") == app.degrade.max_level
+
+        # ---- phase B: full recovery, one level per dwell --------------------
+        deadline = time.monotonic() + min(timeout_s, 20.0)
+        gauge = None
+        while time.monotonic() < deadline:
+            # every scrape ticks a REAL (calm) pressure sample — polling
+            # IS the relax cadence an idle replica lives on
+            gauge = metric("mine_serve_degradation_level")
+            if gauge == 0:
+                break
+            time.sleep(0.05)
+        result["recovered_to_zero"] = gauge == 0
+        # the log leads with its level-0 baseline entry; every step after
+        # it — climb AND descent — must move exactly one level
+        transitions = [lvl for _, lvl in app.degrade.transitions()]
+        result["transitions"] = transitions
+        result["never_skips_a_level"] = all(
+            abs(b - a) == 1 for a, b in
+            zip(transitions, transitions[1:])
+        )
+        code, _, hdr = http("/predict", data=png(9),
+                            headers={"Content-Type": "image/png"})
+        lvl_after, _ = degraded_header(hdr)
+        result["post_recovery_full_fidelity"] = (
+            code == 200 and lvl_after == 0
+            and app.engine.effective_tier() == cfg.serving.cache_tier
+        )
+
+        # ---- phase C: corrupt-checkpoint swap rejected ----------------------
+        gen_before = app.engine.generation
+        schedule = chaos.install("corrupt_ckpt@swap=1")
+        status = app.swap(wait=True)
+        chaos.uninstall()
+        result["corrupt_fired"] = schedule.pending() == []
+        result["corrupt_swap_state"] = status.get("state")
+        result["corrupt_swap_reason"] = status.get("reason")
+        result["corrupt_swap_error_named"] = (
+            "CheckpointCorrupt" in str(status.get("error", ""))
+        )
+        result["corrupt_swap_counter"] = app.metrics.swap_failures.value(
+            reason="corrupt"
+        )
+        result["old_generation_still_serving"] = (
+            app.engine.generation == gen_before
+        )
+        code, body, _ = http("/predict", data=png(11),
+                             headers={"Content-Type": "image/png"})
+        result["post_corrupt_predict_old_step"] = (
+            code == 200
+            and json.loads(body)["mpi_key"].split(":")[1] == "1"
+        )
+        # the fault fired once: the next swap flips normally
+        result["next_swap_ok"] = app.swap(wait=True).get("state") == "ok"
+
+        result["ok"] = (
+            result["pre_spike_full_fidelity"]
+            and result["spike_fired"]
+            and result["climb_reaches_max"]
+            and result["climb_one_level_at_a_time"]
+            and result["degraded_answers_announced"]
+            and result["compressed_tier_announced"]
+            and result["degraded_responses_counted"]
+            and result["gauge_at_max"]
+            and result["recovered_to_zero"]
+            and result["never_skips_a_level"]
+            and result["post_recovery_full_fidelity"]
+            and result["corrupt_fired"]
+            and result["corrupt_swap_state"] == "failed"
+            and result["corrupt_swap_reason"] == "corrupt"
+            and result["corrupt_swap_error_named"]
+            and result["corrupt_swap_counter"] >= 1
+            and result["old_generation_still_serving"]
+            and result["post_corrupt_predict_old_step"]
+            and result["next_swap_ok"]
+        )
+    finally:
+        chaos.uninstall()
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if app is not None:
             app.close()
     return result
 
@@ -1233,7 +1457,8 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--half",
                         choices=("training", "serving", "fleet", "scale",
-                                 "multihost", "datasets", "all"),
+                                 "brownout", "multihost", "datasets",
+                                 "all"),
                         default="all",
                         help="'datasets' sweeps the full dataset-"
                         "conformance matrix (train/eval/serve per config — "
@@ -1272,6 +1497,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.half in ("scale", "all"):
             verdict["scale"] = scale_half(args.timeout_s)
             ok = ok and verdict["scale"]["ok"]
+        if args.half in ("brownout", "all"):
+            verdict["brownout"] = brownout_half(args.timeout_s)
+            ok = ok and verdict["brownout"]["ok"]
         if args.half in ("multihost", "all"):
             verdict["multihost"] = multihost_half(workdir, args.timeout_s)
             ok = ok and verdict["multihost"]["ok"]
